@@ -1,0 +1,48 @@
+"""chandy_lamport_tpu — a TPU-native distributed-snapshot simulation framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+Chandy-Lamport distributed snapshot simulator (a single-process Go
+discrete-time simulator of token-passing nodes, validated by 21 golden
+snapshot fixtures). Instead of translating the Go object graph, the system
+state is a pytree of dense arrays advanced by jitted state-transition kernels,
+batched with ``vmap`` over independent simulation instances and sharded with
+``shard_map`` over a ``jax.sharding.Mesh``.
+
+Layers (mirroring reference layers L0-L4, SURVEY.md §1):
+  - ``core.spec``      message/snapshot/event types (reference common.go)
+  - ``core.parity``    pure-Python oracle, bit-exact vs the Go reference
+  - ``core.topology``  string-id graphs -> dense CSR edge encoding
+  - ``core.dense``     dense array state for the JAX backend
+  - ``ops``            gorand PRNG, ring buffers, the jitted tick kernel
+  - ``models``         graph generators, delay models, the flagship batched sim
+  - ``parallel``       mesh/sharding: instance-parallel + node-sharded modes
+  - ``utils``          fixture parsers, golden comparison, tracing
+"""
+
+from chandy_lamport_tpu.config import SimConfig, MAX_DELAY
+from chandy_lamport_tpu.core.spec import (
+    Message,
+    MsgSnapshot,
+    GlobalSnapshot,
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.api import run_events_file, run_events, make_backend
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SimConfig",
+    "MAX_DELAY",
+    "Message",
+    "MsgSnapshot",
+    "GlobalSnapshot",
+    "PassTokenEvent",
+    "SnapshotEvent",
+    "TickEvent",
+    "run_events_file",
+    "run_events",
+    "make_backend",
+    "__version__",
+]

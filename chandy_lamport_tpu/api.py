@@ -1,0 +1,69 @@
+"""Public API: run event scripts against any backend.
+
+The reference's only entry point is ``go test`` driving
+``readTopologyFile`` + ``readEventsFile`` (test_common.go:29,79). This module
+is the framework's equivalent front door, with the backend made explicit
+(SimulatorBackend seam, SURVEY.md §7.2.7):
+
+  - ``parity``  pure-Python oracle (core/parity.py)
+  - ``jax``     dense jitted single-instance kernel (ops/tick.py)
+
+Both accept any DelayModel; bit-exact golden reproduction requires
+``GoExactDelay(REFERENCE_TEST_SEED + 1)`` (snapshot_test.go:20).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from chandy_lamport_tpu.config import MAX_DELAY, REFERENCE_TEST_SEED, SimConfig
+from chandy_lamport_tpu.core.spec import Event, GlobalSnapshot
+from chandy_lamport_tpu.models.delay import DelayModel, GoExactDelay
+from chandy_lamport_tpu.utils.fixtures import (
+    TopologySpec,
+    read_events_file,
+    read_topology_file,
+)
+
+
+def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
+                 config: Optional[SimConfig] = None, trace: bool = False):
+    if name == "parity":
+        from chandy_lamport_tpu.core.parity import ParitySim
+
+        sim = ParitySim(delay_model, trace=trace)
+        for nid, tokens in topology.nodes:
+            sim.add_node(nid, tokens)
+        for src, dest in topology.links:
+            sim.add_link(src, dest)
+        return sim
+    if name == "jax":
+        from chandy_lamport_tpu.core.dense import DenseSim
+
+        return DenseSim(topology, delay_model, config or SimConfig())
+    raise ValueError(f"unknown backend {name!r} (expected 'parity' or 'jax')")
+
+
+def run_events(backend_name: str, topology: TopologySpec, events: List[Event],
+               delay_model: DelayModel, config: Optional[SimConfig] = None,
+               trace: bool = False):
+    """Run a parsed event script to completion; returns (snapshots, sim)."""
+    sim = make_backend(backend_name, topology, delay_model, config, trace=trace)
+    if backend_name == "parity":
+        from chandy_lamport_tpu.core.parity import run_events as _run
+
+        return _run(sim, events), sim
+    return sim.run_events(events), sim
+
+
+def run_events_file(top_path: str, events_path: str, backend: str = "parity",
+                    seed: int = REFERENCE_TEST_SEED + 1,
+                    delay_model: Optional[DelayModel] = None,
+                    config: Optional[SimConfig] = None,
+                    trace: bool = False) -> Tuple[List[GlobalSnapshot], object]:
+    """Parse fixture files and run them — the ``runTest`` equivalent
+    (snapshot_test.go:11-44) minus the assertions."""
+    topology = read_topology_file(top_path)
+    events = read_events_file(events_path)
+    dm = delay_model if delay_model is not None else GoExactDelay(seed)
+    return run_events(backend, topology, events, dm, config, trace=trace)

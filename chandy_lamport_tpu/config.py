@@ -1,0 +1,43 @@
+"""Framework configuration.
+
+The reference hard-codes four constants (``debug`` common.go:10, ``maxDelay``
+sim.go:10, ``seed`` snapshot_test.go:9, ``testDir`` test_common.go:20). The
+TPU framework additionally needs static capacities because everything the Go
+code grows dynamically (per-link queues, active-snapshot maps, recorded-message
+lists) must become fixed-shape HBM arrays for XLA.
+"""
+
+import dataclasses
+
+# Max random delay added to packet delivery (reference sim.go:10).
+# Delay drawn as 1 + Intn(MAX_DELAY) ticks relative to current time
+# (reference sim.go:100-102): receive_time = time + 1 + Intn(5).
+MAX_DELAY = 5
+
+# Fixed seed used by the reference test suite (reference snapshot_test.go:9,20:
+# rand.Seed(seed + 1)).
+REFERENCE_TEST_SEED = 8053172852482175523
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static capacities and knobs for the dense/JAX backend.
+
+    The Go reference uses unbounded structures; these capacities bound them
+    with overflow flags checked in debug mode (SURVEY.md §7.1.3). Defaults
+    comfortably cover every reference fixture (max in-flight per edge observed
+    across all fixtures is small; 10 snapshots max in 10nodes.events).
+    """
+
+    queue_capacity: int = 16       # per-edge ring buffer slots (C)
+    max_snapshots: int = 16        # concurrent snapshot slots (S)
+    max_recorded: int = 32         # recorded messages per (snapshot, edge) (M)
+    max_delay: int = MAX_DELAY
+    check_overflow: bool = True    # debug-mode capacity assertions
+
+    def __post_init__(self):
+        if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
+            raise ValueError("capacities must be positive")
+
+
+DEFAULT_CONFIG = SimConfig()

@@ -1,0 +1,65 @@
+"""Pluggable message-delay models.
+
+The reference's only nondeterminism is the random delivery delay
+``receiveTime = time + 1 + rand.Intn(maxDelay)`` drawn from Go's global PRNG
+(reference sim.go:100-102). The delay model is the seam between the bit-exact
+path (Go PRNG, draw-order-sensitive) and the fast batched TPU path
+(counter-based jax.random, draw-order-free) — see SURVEY.md §5.
+
+Host-side models (this module) expose ``receive_time(now) -> int`` for the
+parity backend; the JAX backend carries the equivalent state in its pytree
+(ops/tick.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from chandy_lamport_tpu.config import MAX_DELAY
+from chandy_lamport_tpu.ops.gorand import GoRand
+
+
+class DelayModel:
+    def receive_time(self, now: int) -> int:
+        raise NotImplementedError
+
+
+class GoExactDelay(DelayModel):
+    """Bit-exact reference delays: now + 1 + GoRand.Intn(max_delay).
+
+    Matches reference sim.go:100-102 with the global PRNG seeded as in
+    snapshot_test.go:20 (rand.Seed(seed+1) — the caller passes the already
+    incremented seed).
+    """
+
+    def __init__(self, seed: int, max_delay: int = MAX_DELAY, **gorand_kwargs):
+        self.rng = GoRand(seed, **gorand_kwargs)
+        self.max_delay = max_delay
+
+    def receive_time(self, now: int) -> int:
+        return now + 1 + self.rng.intn(self.max_delay)
+
+
+class FixedDelay(DelayModel):
+    """Deterministic constant delay — for unit tests and docs examples."""
+
+    def __init__(self, delay: int = 1):
+        if delay < 1:
+            raise ValueError("delay must be >= 1 (messages are never delivered same-tick)")
+        self.delay = delay
+
+    def receive_time(self, now: int) -> int:
+        return now + self.delay
+
+
+class NumpyUniformDelay(DelayModel):
+    """Fast host-side uniform delays in {1..max_delay} — same distribution as
+    the reference, different stream. Used for property tests and as the
+    host-side twin of the TPU counter-based model."""
+
+    def __init__(self, seed: int, max_delay: int = MAX_DELAY):
+        self.rng = np.random.default_rng(seed)
+        self.max_delay = max_delay
+
+    def receive_time(self, now: int) -> int:
+        return now + 1 + int(self.rng.integers(0, self.max_delay))

@@ -1,0 +1,25 @@
+"""The reference's 7 golden test cases (snapshot_test.go:46-108), shared by
+the pytest suite, the table-search tool, and the CLI's ``test`` command."""
+
+import os
+from typing import List, Tuple
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "..", "data", "test_data")
+
+# (topology file, events file, golden snapshot files)
+REFERENCE_TESTS: List[Tuple[str, str, List[str]]] = [
+    ("2nodes.top", "2nodes-simple.events", ["2nodes-simple.snap"]),
+    ("2nodes.top", "2nodes-message.events", ["2nodes-message.snap"]),
+    ("3nodes.top", "3nodes-simple.events", ["3nodes-simple.snap"]),
+    ("3nodes.top", "3nodes-bidirectional-messages.events",
+     ["3nodes-bidirectional-messages.snap"]),
+    ("8nodes.top", "8nodes-sequential-snapshots.events",
+     [f"8nodes-sequential-snapshots{i}.snap" for i in range(2)]),
+    ("8nodes.top", "8nodes-concurrent-snapshots.events",
+     [f"8nodes-concurrent-snapshots{i}.snap" for i in range(5)]),
+    ("10nodes.top", "10nodes.events", [f"10nodes{i}.snap" for i in range(10)]),
+]
+
+
+def fixture_path(name: str) -> str:
+    return os.path.join(DATA_DIR, name)

@@ -1,0 +1,70 @@
+"""Epoch-bucketed event trace — the parity backend's observability hook.
+
+Replicates the reference Logger (logger.go:12-76): events bucketed per time
+step, each capturing the node's token balance at record time (logger.go:74 —
+note sends record the balance *before* the debit, node.go:118-120). Pretty
+printing matches the reference's record strings (common.go:75-122).
+
+For the JAX backend, structured per-event capture is incompatible with jit;
+its equivalents are (a) aggregate per-tick counters returned as arrays
+(ops/tick.py TickStats) and (b) ``jax.profiler`` for kernel-level timing
+(SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from chandy_lamport_tpu.core.spec import Message
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    node_id: str
+    node_tokens: int  # balance when recorded (logger.go:18-23)
+    text: str
+
+
+class EpochTrace:
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.epochs: List[List[TraceEvent]] = []
+
+    def new_epoch(self) -> None:
+        if self.enabled:
+            self.epochs.append([])
+
+    def _record(self, node, text: str) -> None:
+        if self.enabled:
+            self.epochs[-1].append(TraceEvent(node.id, node.tokens, text))
+
+    def sent(self, node, dest: str, msg: Message) -> None:
+        if not self.enabled:
+            return
+        if msg.is_marker:
+            self._record(node, f"{node.id} sent marker({msg.data}) to {dest}")
+        else:
+            self._record(node, f"{node.id} sent {msg.data} tokens to {dest}")
+
+    def received(self, node, src: str, msg: Message) -> None:
+        if not self.enabled:
+            return
+        if msg.is_marker:
+            self._record(node, f"{node.id} received marker({msg.data}) from {src}")
+        else:
+            self._record(node, f"{node.id} received {msg.data} tokens from {src}")
+
+    def start_snapshot(self, node, snapshot_id: int) -> None:
+        self._record(node, f"{node.id} startSnapshot({snapshot_id})")
+
+    def end_snapshot(self, node, snapshot_id: int) -> None:
+        self._record(node, f"{node.id} endSnapshot({snapshot_id})")
+
+    def pretty(self) -> str:
+        out = []
+        for t, events in enumerate(self.epochs):
+            if events:
+                out.append(f"Time {t}:")
+                out.extend(f"\t{e.node_id}: {e.text}" for e in events)
+        return "\n".join(out)

@@ -1,0 +1,59 @@
+"""Unit tests for the fixture parsers (reference test_common.go:29-193)."""
+
+import pytest
+
+from chandy_lamport_tpu.core.spec import PassTokenEvent, SnapshotEvent, TickEvent
+from chandy_lamport_tpu.utils.fixtures import (
+    read_events_file,
+    read_snapshot_file,
+    read_topology_file,
+)
+from chandy_lamport_tpu.utils.goldens import fixture_path
+
+
+def test_topology_2nodes():
+    t = read_topology_file(fixture_path("2nodes.top"))
+    assert t.nodes == [("N1", 1), ("N2", 0)]
+    assert t.links == [("N1", "N2"), ("N2", "N1")]
+
+
+def test_topology_8nodes_comments_ignored():
+    t = read_topology_file(fixture_path("8nodes.top"))
+    assert len(t.nodes) == 8
+    # two bridged bidirectional 4-cycles -> 2*4*2 + 2 arcs
+    assert len(t.links) == 18
+
+
+def test_events_parsing():
+    ev = read_events_file(fixture_path("2nodes-message.events"))
+    assert ev == [PassTokenEvent("N1", "N2", 1), SnapshotEvent("N2"), TickEvent(1)]
+
+
+def test_events_tick_default_and_count():
+    ev = read_events_file(fixture_path("8nodes-sequential-snapshots.events"))
+    ticks = [e.n for e in ev if isinstance(e, TickEvent)]
+    assert 10 in ticks  # "tick 10" lines parse their count
+
+
+def test_events_comments_supported(tmp_path):
+    # The reference's comment filter is inert (swapped HasPrefix args,
+    # test_common.go:90); ours must actually work.
+    p = tmp_path / "c.events"
+    p.write_text("# a comment\nsend N1 N2 3\n")
+    assert read_events_file(str(p)) == [PassTokenEvent("N1", "N2", 3)]
+
+
+def test_snapshot_parsing():
+    s = read_snapshot_file(fixture_path("2nodes-message.snap"))
+    assert s.id == 0
+    assert s.token_map == {"N1": 0, "N2": 0}
+    assert len(s.messages) == 1
+    m = s.messages[0]
+    assert (m.src, m.dest, m.message.is_marker, m.message.data) == ("N1", "N2", False, 1)
+
+
+def test_snapshot_rejects_unknown_message(tmp_path):
+    p = tmp_path / "bad.snap"
+    p.write_text("0\nN1 5\nN1 N2 marker(0)\n")
+    with pytest.raises(ValueError):
+        read_snapshot_file(str(p))

@@ -1,0 +1,36 @@
+"""Golden-file integration tests on the parity backend — the 7 reference
+tests (snapshot_test.go:46-108) reproduced bit-exactly, plus the
+token-conservation invariant (test_common.go:298-328)."""
+
+import pytest
+
+from chandy_lamport_tpu.api import run_events_file
+from chandy_lamport_tpu.utils.compare import (
+    assert_snapshots_equal,
+    check_tokens,
+    sort_snapshots,
+)
+from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+
+
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=[t[1].removesuffix(".events") for t in REFERENCE_TESTS])
+def test_golden_parity(top, events, snaps):
+    actual, sim = run_events_file(fixture_path(top), fixture_path(events),
+                                  backend="parity")
+    assert len(actual) == len(snaps)
+    check_tokens(sim.node_tokens(), actual)
+    expected = [read_snapshot_file(fixture_path(f)) for f in snaps]
+    for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+        assert_snapshots_equal(e, a)
+
+
+def test_trace_mode_produces_epochs():
+    _, sim = run_events_file(fixture_path("2nodes.top"),
+                             fixture_path("2nodes-simple.events"),
+                             backend="parity", trace=True)
+    text = sim.trace.pretty()
+    assert "startSnapshot(0)" in text
+    assert "endSnapshot(0)" in text
+    assert "marker(0)" in text

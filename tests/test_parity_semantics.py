@@ -1,0 +1,162 @@
+"""Semantic unit + property tests for the parity backend — coverage the
+reference lacks (SURVEY.md §4.4): tick-rule unit tests with deterministic
+delays, and token-conservation under randomized topologies/scripts."""
+
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.core.parity import ParitySim, run_events
+from chandy_lamport_tpu.core.spec import (
+    Message,
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.models.delay import FixedDelay, NumpyUniformDelay
+from chandy_lamport_tpu.utils.compare import check_tokens
+
+
+def make_sim(nodes, links, delay):
+    sim = ParitySim(delay)
+    for nid, tok in nodes:
+        sim.add_node(nid, tok)
+    for s, d in links:
+        sim.add_link(s, d)
+    return sim
+
+
+def ring(n, tokens=10):
+    ids = [f"N{i+1}" for i in range(n)]
+    nodes = [(i, tokens) for i in ids]
+    links = [(ids[i], ids[(i + 1) % n]) for i in range(n)]
+    return nodes, links
+
+
+def test_fifo_per_channel_and_delay():
+    # delay=2: message sent at t=0 arrives exactly at t=2 (rt = now+delay).
+    sim = make_sim(*ring(2), FixedDelay(2))
+    sim.process_event(PassTokenEvent("N1", "N2", 3))
+    sim.tick()
+    assert sim.nodes["N2"].tokens == 10
+    sim.tick()
+    assert sim.nodes["N2"].tokens == 13
+    assert sim.nodes["N1"].tokens == 7
+
+
+def test_one_delivery_per_source_per_tick():
+    # Two messages on the same channel, both eligible: only one delivered per
+    # tick (sim.go:90 break), FIFO order.
+    sim = make_sim(*ring(2), FixedDelay(1))
+    sim.process_event(PassTokenEvent("N1", "N2", 1))
+    sim.process_event(PassTokenEvent("N1", "N2", 2))
+    sim.tick()
+    assert sim.nodes["N2"].tokens == 11
+    sim.tick()
+    assert sim.nodes["N2"].tokens == 13
+
+
+def test_head_of_line_blocking():
+    # Head has rt=5, behind it rt would also be 5; nothing delivered earlier
+    # even if a *later* message could theoretically arrive sooner: the head
+    # blocks the channel (sim.go:82-84 peeks only the head).
+    class Seq:
+        def __init__(self, delays):
+            self.delays = list(delays)
+
+        def receive_time(self, now):
+            return now + self.delays.pop(0)
+
+    sim = make_sim(*ring(2), Seq([5, 1]))
+    sim.process_event(PassTokenEvent("N1", "N2", 1))  # rt=5
+    sim.process_event(PassTokenEvent("N1", "N2", 2))  # rt=1, stuck behind
+    for _ in range(4):
+        sim.tick()
+    assert sim.nodes["N2"].tokens == 10
+    sim.tick()  # t=5: head eligible
+    assert sim.nodes["N2"].tokens == 11
+    sim.tick()
+    assert sim.nodes["N2"].tokens == 13
+
+
+def test_sorted_source_order_n10_before_n2():
+    # Lexicographic ordering: "N10" < "N2" (SURVEY §7.0 rule 1).
+    assert sorted(["N2", "N10", "N1"]) == ["N1", "N10", "N2"]
+
+
+def test_initiator_records_all_inbound_marker_case_excludes_src():
+    sim = make_sim(*ring(3), FixedDelay(1))
+    sim.start_snapshot("N1")
+    snap = sim.nodes["N1"].active[0]
+    assert snap.links_remaining == 1  # N1's only inbound is N3
+    assert snap.recording == {"N3": True}
+    sim.tick()  # marker N1->N2 delivered; N2 creates snapshot excluding N1
+    snap2 = sim.nodes["N2"].active[0]
+    assert snap2.recording == {"N1": False}
+    assert snap2.links_remaining == 0
+    assert snap2.done  # single-inbound node finalizes on first marker
+
+
+def test_token_sent_before_marker_is_recorded():
+    # Classic consistent-cut scenario: token in flight across the cut line.
+    sim = make_sim([("N1", 5), ("N2", 0)], [("N1", "N2"), ("N2", "N1")],
+                   FixedDelay(3))
+    snaps = run_events(sim, [
+        PassTokenEvent("N1", "N2", 2),  # rt=3
+        SnapshotEvent("N2"),            # N2 freezes 0, records N1->N2
+    ])
+    assert snaps[0].token_map == {"N1": 3, "N2": 0}
+    assert [(m.src, m.dest, m.message.data) for m in snaps[0].messages] == \
+        [("N1", "N2", 2)]
+
+
+def test_concurrent_snapshots_record_independently():
+    sim = make_sim(*ring(4), FixedDelay(1))
+    events = [SnapshotEvent("N1"), SnapshotEvent("N3"), TickEvent(1),
+              PassTokenEvent("N2", "N3", 5)]
+    snaps = run_events(sim, events)
+    assert {s.id for s in snaps} == {0, 1}
+    check_tokens(sim.node_tokens(), snaps)
+
+
+def test_send_more_than_balance_raises():
+    sim = make_sim(*ring(2, tokens=1), FixedDelay(1))
+    with pytest.raises(ValueError):
+        sim.process_event(PassTokenEvent("N1", "N2", 99))
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_property_conservation_random_scripts(trial):
+    rng = np.random.default_rng(1000 + trial)
+    n = int(rng.integers(2, 8))
+    ids = [f"N{i+1}" for i in range(n)]
+    nodes = [(i, int(rng.integers(0, 30))) for i in ids]
+    # strongly-connected base ring + random extra arcs
+    links = {(ids[i], ids[(i + 1) % n]) for i in range(n)}
+    for _ in range(int(rng.integers(0, n * 2))):
+        a, b = rng.choice(n, size=2, replace=False)
+        links.add((ids[a], ids[b]))
+    outbound = {i: sorted(d for s, d in links if s == i) for i in ids}
+    events = []
+    for _ in range(int(rng.integers(5, 40))):
+        r = rng.random()
+        if r < 0.5:
+            src = ids[int(rng.integers(n))]
+            dests = outbound[src]
+            events.append(PassTokenEvent(src, dests[int(rng.integers(len(dests)))], 1))
+        elif r < 0.7:
+            events.append(SnapshotEvent(ids[int(rng.integers(n))]))
+        else:
+            events.append(TickEvent(int(rng.integers(1, 4))))
+    # Large balances so random sends never overdraw.
+    sim = make_sim([(i, 1000) for i in ids], sorted(links), NumpyUniformDelay(trial))
+    snaps = run_events(sim, events)
+    assert sim.total_tokens() == n * 1000  # conservation incl. in-flight
+    # The reference's checkTokens compares against node balances only, so
+    # fully drain the network first (the fixtures happen to be drained after
+    # the standard flush; random scripts need not be).
+    while sim.total_tokens() != sum(sim.node_tokens().values()):
+        sim.tick()
+    check_tokens(sim.node_tokens(), snaps)
+    for s in snaps:
+        assert len(s.token_map) == n
+        assert {m.dest for m in s.messages} <= set(ids)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Select the correct regenerated rngCooked table using the golden fixtures.
+
+Search space (see tools/gen_cooked.py): 2 bootstrap-shift variants x 3 output
+orderings for the table, crossed with 2 possible Seed() packing shifts. A
+candidate is accepted only if the parity backend reproduces ALL 21 golden
+snapshots across all 7 reference test cases. On success, vendors the table to
+chandy_lamport_tpu/data/gorand_cooked.npy and prints the winning combo.
+"""
+
+import glob
+import itertools
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from chandy_lamport_tpu.config import REFERENCE_TEST_SEED
+from chandy_lamport_tpu.core.parity import ParitySim, run_events
+from chandy_lamport_tpu.models.delay import GoExactDelay
+from chandy_lamport_tpu.utils.compare import (
+    assert_snapshots_equal,
+    check_tokens,
+    sort_snapshots,
+)
+from chandy_lamport_tpu.utils.fixtures import (
+    read_events_file,
+    read_snapshot_file,
+    read_topology_file,
+)
+
+DATA = os.path.join(os.path.dirname(__file__), "..", "chandy_lamport_tpu", "data")
+TESTS = [
+    ("2nodes.top", "2nodes-simple.events", ["2nodes-simple.snap"]),
+    ("2nodes.top", "2nodes-message.events", ["2nodes-message.snap"]),
+    ("3nodes.top", "3nodes-simple.events", ["3nodes-simple.snap"]),
+    ("3nodes.top", "3nodes-bidirectional-messages.events",
+     ["3nodes-bidirectional-messages.snap"]),
+    ("8nodes.top", "8nodes-sequential-snapshots.events",
+     [f"8nodes-sequential-snapshots{i}.snap" for i in range(2)]),
+    ("8nodes.top", "8nodes-concurrent-snapshots.events",
+     [f"8nodes-concurrent-snapshots{i}.snap" for i in range(5)]),
+    ("10nodes.top", "10nodes.events", [f"10nodes{i}.snap" for i in range(10)]),
+]
+
+
+def try_combo(cooked, seed_shifts, tests):
+    for top, events, snaps in tests:
+        td = os.path.join(DATA, "test_data")
+        topo = read_topology_file(os.path.join(td, top))
+        evs = read_events_file(os.path.join(td, events))
+        dm = GoExactDelay(REFERENCE_TEST_SEED + 1, cooked=cooked, seed_shifts=seed_shifts)
+        sim = ParitySim(dm)
+        for nid, tok in topo.nodes:
+            sim.add_node(nid, tok)
+        for s, d in topo.links:
+            sim.add_link(s, d)
+        actual = run_events(sim, evs)
+        expected = [read_snapshot_file(os.path.join(td, f)) for f in snaps]
+        if len(actual) != len(expected):
+            return f"{events}: snapshot count {len(actual)} != {len(expected)}"
+        check_tokens(sim.node_tokens(), actual)
+        for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+            assert_snapshots_equal(e, a)
+    return None
+
+
+def main():
+    candidates = sorted(glob.glob(os.path.join(DATA, "cooked_candidates", "*.npy")))
+    assert candidates, "run tools/gen_cooked.py first"
+    winners = []
+    # Discriminating subset first (3nodes draws many times), full run for survivors.
+    quick = [TESTS[2]]
+    for path, seed_shifts in itertools.product(candidates, [(40, 20), (20, 10)]):
+        cooked = np.load(path)
+        try:
+            err = try_combo(cooked, seed_shifts, quick)
+        except Exception as e:  # mismatch exceptions count as failures
+            err = str(e)
+        tag = f"{os.path.basename(path)} seed_shifts={seed_shifts}"
+        if err:
+            print(f"FAIL  {tag}: {err[:110]}")
+            continue
+        try:
+            err = try_combo(cooked, seed_shifts, TESTS)
+        except Exception as e:
+            err = str(e)
+        if err:
+            print(f"PARTIAL {tag}: passed 3nodes but: {err[:110]}")
+            continue
+        print(f"PASS  {tag}: all 7 tests / 21 goldens")
+        winners.append((path, seed_shifts, cooked))
+    if len(winners) == 1:
+        path, seed_shifts, cooked = winners[0]
+        out = os.path.join(DATA, "gorand_cooked.npy")
+        np.save(out, cooked)
+        print(f"\nvendored {os.path.basename(path)} (seed_shifts={seed_shifts}) -> {out}")
+        if seed_shifts != (40, 20):
+            print("WARNING: update GoRand default seed_shifts to", seed_shifts)
+    elif not winners:
+        print("\nNO candidate passed — widen the search (discard count? orderings?)")
+        sys.exit(1)
+    else:
+        print(f"\nAMBIGUOUS: {len(winners)} winners — need a tie-breaker")
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

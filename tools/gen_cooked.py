@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Regenerate Go's ``math/rand`` legacy ``rngCooked`` seeding table from scratch.
+
+Go's legacy PRNG (``rngSource``) is a 607-lag / 273-tap additive lagged-Fibonacci
+generator over Z/2^64.  Its ``Seed`` method XORs a Schrage-LCG seed chain with a
+precomputed 607-entry table ``rngCooked`` — the generator state obtained by
+seeding a bootstrap state with 1 and discarding 7.8e12 outputs (per Go's
+``gen_cooked.go``).  That table cannot be fetched here (zero egress, no Go
+toolchain on the machine — verified), so we regenerate it.
+
+The recurrence ``vec[feed] += vec[tap]`` is *linear* over Z/2^64, so instead of
+7.8e12 scalar steps (~hours) we exponentiate the 607-step block matrix B
+(each block updates every lane exactly once and returns tap/feed to their
+starting positions):  state_after = B^q @ state0, then r = N mod 607 residual
+scalar steps.  B^q needs ~34 squarings of a 607x607 matrix over Z/2^64, done
+exactly with float64 BLAS via 16-bit limb decomposition (products < 2^32,
+row-sums < 2^32 * 607 < 2^53, so float64 matmul is exact).
+
+Because two details of the upstream bootstrap are not reliably derivable from
+memory, we emit *candidate* tables over a small search space and let the 21
+golden snapshot fixtures (the ground-truth oracle) pick the right one:
+  - bootstrap srand() packing shifts: (20,10,0) or (40,20,0)
+  - output ordering: vec[(tap+i)%607], vec[i], or vec[(feed+i)%607]
+
+Usage: python tools/gen_cooked.py [--selftest] [--out DIR]
+Writes candidates to DIR (default chandy_lamport_tpu/data/cooked_candidates/).
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+LEN = 607
+TAP = 273
+FEED0 = LEN - TAP  # 334
+MASK64 = (1 << 64) - 1
+# Schrage LCG constants (Go math/rand rng.go / gen_cooked.go)
+A, M, Q, R = 48271, (1 << 31) - 1, 44488, 3399
+DISCARD = 7_800_000_000_000  # gen_cooked.go discard count
+
+
+def seedrand(x: int) -> int:
+    """One step of the Schrage-split Lehmer LCG: x = A*x mod M without overflow."""
+    hi, lo = divmod(x, Q)
+    x = A * lo - R * hi
+    if x < 0:
+        x += M
+    return x
+
+
+def bootstrap_state(seed: int, shifts) -> np.ndarray:
+    """srand(): fill the 607-lane state from the LCG chain (gen_cooked.go srand)."""
+    s1, s2 = shifts
+    seed %= M
+    if seed < 0:
+        seed += M
+    if seed == 0:
+        seed = 89482311
+    x = seed
+    vec = np.zeros(LEN, dtype=np.uint64)
+    for i in range(-20, LEN):
+        x = seedrand(x)
+        if i >= 0:
+            u = (x << s1) & MASK64
+            x = seedrand(x)
+            u ^= (x << s2) & MASK64
+            x = seedrand(x)
+            u ^= x
+            vec[i] = u
+    return vec
+
+
+def direct_steps(vec: np.ndarray, n: int, tap: int = 0, feed: int = FEED0):
+    """n scalar vrand() steps: tap--, feed-- (mod LEN), vec[feed] += vec[tap]."""
+    v = vec.copy()
+    for _ in range(n):
+        tap = (tap - 1) % LEN
+        feed = (feed - 1) % LEN
+        v[feed] = v[feed] + v[tap]  # uint64 wraparound
+    return v, tap, feed
+
+
+def block_matrix() -> np.ndarray:
+    """B such that 607 vrand steps == B @ v (over Z/2^64).
+
+    Apply the 607 elementary row operations to the identity matrix.
+    """
+    B = np.eye(LEN, dtype=np.uint64)
+    tap, feed = 0, FEED0
+    for _ in range(LEN):
+        tap = (tap - 1) % LEN
+        feed = (feed - 1) % LEN
+        B[feed, :] += B[tap, :]
+    assert tap == 0 and feed == FEED0
+    return B
+
+
+def matmul_u64(X: np.ndarray, Y: np.ndarray) -> np.ndarray:
+    """Exact (X @ Y) mod 2^64 using 16-bit limbs + float64 BLAS."""
+    xl = [((X >> np.uint64(16 * k)) & np.uint64(0xFFFF)).astype(np.float64) for k in range(4)]
+    yl = [((Y >> np.uint64(16 * k)) & np.uint64(0xFFFF)).astype(np.float64) for k in range(4)]
+    out = np.zeros(X.shape[:1] + Y.shape[1:], dtype=np.uint64)
+    for i in range(4):
+        for j in range(4 - i):
+            p = (xl[i] @ yl[j]).astype(np.uint64)  # exact: < 2^32 * 607 < 2^53
+            out += p << np.uint64(16 * (i + j))  # wraps mod 2^64
+    return out
+
+
+def matvec_u64(Mx: np.ndarray, v: np.ndarray) -> np.ndarray:
+    return (Mx * v[None, :]).sum(axis=1, dtype=np.uint64)
+
+
+def jump(vec: np.ndarray, n: int):
+    """State after n vrand steps from (tap=0, feed=FEED0), via matrix exponentiation."""
+    q, r = divmod(n, LEN)
+    v = vec.copy()
+    P = block_matrix()
+    while q:
+        if q & 1:
+            v = matvec_u64(P, v)
+        q >>= 1
+        if q:
+            P = matmul_u64(P, P)
+    return direct_steps(v, r)
+
+
+def selftest():
+    v0 = bootstrap_state(1, (20, 10))
+    for n in (0, 1, 606, 607, 608, 12345):
+        a, ta, fa = jump(v0, n)
+        b, tb, fb = direct_steps(v0, n)
+        assert (a == b).all() and ta == tb and fa == fb, f"jump mismatch at n={n}"
+    # matmul_u64 sanity vs python ints on random small matrices
+    rng = np.random.default_rng(0)
+    X = rng.integers(0, 1 << 64, size=(13, 13), dtype=np.uint64)
+    Y = rng.integers(0, 1 << 64, size=(13, 13), dtype=np.uint64)
+    Z = matmul_u64(X, Y)
+    for i in range(13):
+        for j in range(13):
+            want = sum(int(X[i, k]) * int(Y[k, j]) for k in range(13)) & MASK64
+            assert int(Z[i, j]) == want
+    print("selftest OK")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--selftest", action="store_true")
+    ap.add_argument("--out", default=os.path.join(os.path.dirname(__file__), "..",
+                                                  "chandy_lamport_tpu", "data",
+                                                  "cooked_candidates"))
+    args = ap.parse_args()
+    if args.selftest:
+        selftest()
+        return
+    os.makedirs(args.out, exist_ok=True)
+    for shifts in ((20, 10), (40, 20)):
+        v0 = bootstrap_state(1, shifts)
+        vec, tap, feed = jump(v0, DISCARD)
+        for name, order in (
+            ("tap", (np.arange(LEN) + tap) % LEN),
+            ("raw", np.arange(LEN)),
+            ("feed", (np.arange(LEN) + feed) % LEN),
+        ):
+            table = vec[order]
+            path = os.path.join(args.out, f"cooked_s{shifts[0]}_{shifts[1]}_{name}.npy")
+            np.save(path, table)
+            print(path, "first:", table[0], "tap:", tap, "feed:", feed)
+
+
+if __name__ == "__main__":
+    main()
