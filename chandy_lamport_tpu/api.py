@@ -31,13 +31,20 @@ def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
     if name == "parity":
         from chandy_lamport_tpu.core.parity import ParitySim
 
-        sim = ParitySim(delay_model, trace=trace)
+        sim = ParitySim(delay_model,
+                        max_delay=getattr(delay_model, "max_delay", MAX_DELAY),
+                        trace=trace)
         for nid, tokens in topology.nodes:
             sim.add_node(nid, tokens)
         for src, dest in topology.links:
             sim.add_link(src, dest)
         return sim
     if name == "jax":
+        if trace:
+            raise ValueError(
+                "trace=True is only supported on the parity backend — "
+                "structured per-event capture is incompatible with the jit "
+                "hot loop (SURVEY.md §5); use backend='parity' for traces")
         from chandy_lamport_tpu.core.dense import DenseSim
 
         return DenseSim(topology, delay_model, config or SimConfig())

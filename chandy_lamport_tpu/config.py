@@ -33,7 +33,7 @@ class SimConfig:
     max_snapshots: int = 16        # concurrent snapshot slots (S)
     max_recorded: int = 32         # recorded messages per (snapshot, edge) (M)
     max_delay: int = MAX_DELAY
-    check_overflow: bool = True    # debug-mode capacity assertions
+    max_ticks: int = 100_000       # drain-loop budget (guards non-strongly-connected graphs)
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:

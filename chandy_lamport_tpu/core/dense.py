@@ -1,0 +1,143 @@
+"""DenseSim — the JAX backend driver around the jitted tick kernel.
+
+Pairs the dense array state (core/state.py) with the jitted kernel
+(ops/tick.py) behind the same interface the parity backend exposes, so the
+two are drop-in interchangeable through api.run_events / run_events_file and
+differential tests can compare them on identical inputs.
+
+Event scripts are orchestrated from the host (events are few and happen
+between ticks, reference test_common.go:79-140); ticks, the drain loop and
+the flush run fully under jit. Snapshot decode back to GlobalSnapshot happens
+once at the end from a single device_get.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Union
+
+import jax
+import numpy as np
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import (
+    Event,
+    GlobalSnapshot,
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.core.state import (
+    DenseState,
+    DenseTopology,
+    decode_errors,
+    decode_snapshot,
+    init_state,
+)
+from chandy_lamport_tpu.models.delay import DelayModel
+from chandy_lamport_tpu.ops.delay_jax import JaxDelay, from_host_model
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+
+class DenseBackendError(RuntimeError):
+    """Raised when the kernel's sticky error bitmask is non-zero after a run
+    (the jit-compatible stand-in for the reference's log.Fatal calls)."""
+
+
+class DenseSim:
+    """Single-instance dense simulator on the JAX backend."""
+
+    def __init__(self, topology: TopologySpec,
+                 delay_model: Union[DelayModel, JaxDelay],
+                 config: Optional[SimConfig] = None):
+        self.config = config or SimConfig()
+        self.topo = DenseTopology(topology)
+        self.delay = (delay_model if isinstance(delay_model, JaxDelay)
+                      else from_host_model(delay_model))
+        # the flush length must cover the sampler's actual max delay
+        # (test_common.go:135-137 flushes maxDelay+1 ticks)
+        if self.delay.max_delay != self.config.max_delay:
+            self.config = dataclasses.replace(
+                self.config, max_delay=self.delay.max_delay)
+        self.kernel = TickKernel(self.topo, self.config, self.delay)
+        self.state: DenseState = init_state(
+            self.topo, self.config, self.delay.init_state())
+        self._host_cache: Optional[DenseState] = None
+        # host mirror of state.next_sid (ids are allocated sequentially,
+        # sim.go:107-108) so collection knows which slots this run started
+        self._next_sid = 0
+
+    # -- event execution ---------------------------------------------------
+
+    def process_event(self, event: Event) -> None:
+        self._host_cache = None
+        if isinstance(event, PassTokenEvent):
+            src = self._node_index(event.src)
+            dest = self._node_index(event.dest)
+            e = self.topo.edge_index.get((src, dest))
+            if e is None:
+                raise ValueError(f"no link {event.src} -> {event.dest}")
+            self.state = self.kernel.inject_send(
+                self.state, np.int32(e), np.int32(event.tokens))
+        elif isinstance(event, SnapshotEvent):
+            node = self._node_index(event.node_id)
+            self._next_sid += 1
+            self.state = self.kernel.inject_snapshot(self.state, np.int32(node))
+        elif isinstance(event, TickEvent):
+            self.state = self.kernel.run_ticks(self.state, np.int32(event.n))
+        else:
+            raise TypeError(f"unknown event: {event!r}")
+
+    def run_events(self, events: List[Event]) -> List[GlobalSnapshot]:
+        """Execute a script + drain + flush; mirrors parity.run_events /
+        reference test_common.go:79-140."""
+        started: List[int] = []
+        for ev in events:
+            if isinstance(ev, SnapshotEvent):
+                started.append(self._next_sid)
+            self.process_event(ev)
+        self.state = self.kernel.drain_and_flush(self.state)
+        self._host_cache = None
+        self.check_errors()
+        host = self._host()
+        return [decode_snapshot(self.topo, host, s) for s in started]
+
+    # -- introspection (same surface as ParitySim) -------------------------
+
+    def node_tokens(self):
+        host = self._host()
+        return {nid: int(host.tokens[i]) for i, nid in enumerate(self.topo.ids)}
+
+    def total_tokens(self) -> int:
+        """Node balances + in-flight non-marker tokens (the conserved
+        quantity, test_common.go:298-328)."""
+        host = self._host()
+        total = int(host.tokens.sum())
+        C = self.config.queue_capacity
+        for e in range(self.topo.e):
+            head, length = int(host.q_head[e]), int(host.q_len[e])
+            for k in range(length):
+                slot = (head + k) % C
+                if not host.q_marker[e, slot]:
+                    total += int(host.q_data[e, slot])
+        return total
+
+    def check_errors(self) -> None:
+        bits = int(self._host().error)
+        if bits:
+            raise DenseBackendError(
+                "dense backend error(s): " + "; ".join(decode_errors(bits)))
+
+    # -- internals ---------------------------------------------------------
+
+    def _node_index(self, node_id: str) -> int:
+        idx = self.topo.index.get(node_id)
+        if idx is None:
+            raise ValueError(f"node {node_id} does not exist")
+        return idx
+
+    def _host(self) -> DenseState:
+        if self._host_cache is None:
+            self._host_cache = jax.device_get(self.state)
+        return self._host_cache

@@ -1,0 +1,174 @@
+"""Dense array encoding of the simulator — topology and state pytree.
+
+This is the SURVEY.md §7.1.3/§7.1.4 design: every unbounded Go structure
+(per-link ``container/list`` queues, ``activeSnapshots`` maps, recorded
+message lists) becomes a fixed-shape HBM array with explicit capacities from
+``SimConfig``, and every string-keyed map iteration becomes index order over
+lexicographically-ranked dense indices.
+
+Topology encoding (static per run, baked into the jitted kernel):
+  - node index = rank of node id under lexicographic sort (so Go's sorted map
+    iteration, reference sim.go:76 / common.go:135-146, is plain index order);
+  - edges sorted by (src_rank, dest_rank) — per-source contiguous and
+    dest-sorted, which makes both the tick's sorted-dest scan (sim.go:78) and
+    the marker broadcast order (node.go:98) a linear walk;
+  - ``edge_table[N, D]`` pads each source's outbound edges to the max
+    out-degree D with -1.
+
+State encoding (the jit carry; one instance — batching vmaps the whole tuple):
+  - per-edge ring buffers replace the FIFO queues (queue.go:6-28):
+    ``q_*[E, C]`` + ``q_head[E]`` + ``q_len[E]``, append at
+    (head+len) % C, pop at head — FIFO with head-of-line blocking intact;
+  - snapshot slot s holds snapshot id s (ids are allocated sequentially from
+    0, reference sim.go:107-108, so slot==id while id < S);
+  - ``recording[S, E]`` replaces per-snapshot ``isLinkRecording`` maps
+    (node.go:39); ``rec_data[S, E, M]`` + ``rec_len[S, E]`` replace the
+    ``incomingMessages`` lists (node.go:38) — only token amounts are stored
+    because only non-marker messages are ever recorded (node.go:174-185);
+  - ``completed[S]`` replaces the per-snapshot WaitGroup (sim.go:17);
+  - ``error`` is a sticky bitmask replacing Go's log.Fatal / unbounded growth
+    (checked on the host after a run; SURVEY.md §5 "sanitizer" equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import numpy as np
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import GlobalSnapshot, Message, MsgSnapshot
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+# error bitmask flags
+ERR_QUEUE_OVERFLOW = 1
+ERR_SNAPSHOT_OVERFLOW = 2
+ERR_RECORD_OVERFLOW = 4
+ERR_TOKEN_UNDERFLOW = 8
+ERR_TICK_LIMIT = 16
+
+ERROR_NAMES = {
+    ERR_QUEUE_OVERFLOW: "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)",
+    ERR_SNAPSHOT_OVERFLOW: "concurrent snapshot slots exceeded (raise SimConfig.max_snapshots)",
+    ERR_RECORD_OVERFLOW: "recorded-message capacity exceeded (raise SimConfig.max_recorded)",
+    ERR_TOKEN_UNDERFLOW: "node sent more tokens than it had (reference log.Fatal, node.go:113-116)",
+    ERR_TICK_LIMIT: "drain loop hit max_ticks (graph not strongly connected?)",
+}
+
+
+class DenseTopology:
+    """Static graph arrays; node index = lexicographic rank of the node id."""
+
+    def __init__(self, spec: TopologySpec):
+        self.ids: List[str] = sorted(spec.node_ids)
+        if len(set(self.ids)) != len(self.ids):
+            raise ValueError("duplicate node ids in topology")
+        self.index: Dict[str, int] = {nid: i for i, nid in enumerate(self.ids)}
+        self.n = len(self.ids)
+        tokens0 = dict(spec.nodes)
+        self.tokens0 = np.array([tokens0[nid] for nid in self.ids], dtype=np.int32)
+
+        for src, dest in spec.links:
+            if src not in self.index:
+                raise ValueError(f"node {src} does not exist")  # sim.go:49-54
+            if dest not in self.index:
+                raise ValueError(f"node {dest} does not exist")
+        # self-links silently ignored (node.go:88-90); duplicate arcs collapse
+        # (outboundLinks is a map, node.go:91-93)
+        edges = sorted({(self.index[s], self.index[d])
+                        for s, d in spec.links if s != d})
+        self.e = len(edges)
+        self.edge_src = np.array([s for s, _ in edges], dtype=np.int32)
+        self.edge_dst = np.array([d for _, d in edges], dtype=np.int32)
+        self.edge_index: Dict[Tuple[int, int], int] = {
+            (s, d): i for i, (s, d) in enumerate(edges)}
+
+        out_count = np.bincount(self.edge_src, minlength=self.n)
+        self.in_degree = np.bincount(self.edge_dst, minlength=self.n).astype(np.int32)
+        self.d = int(out_count.max()) if self.e else 1
+        self.edge_table = np.full((self.n, self.d), -1, dtype=np.int32)
+        fill = np.zeros(self.n, dtype=np.int64)
+        for i, (s, _) in enumerate(edges):
+            self.edge_table[s, fill[s]] = i  # dest-sorted within each row
+            fill[s] += 1
+        # per-node inbound edge ids in src-rank order (edges are (src,dst)
+        # sorted, so a filter preserves src order) — used at decode time for
+        # the sorted-src flattening of recorded messages (SURVEY.md §2.2 R9)
+        self.in_edges: List[List[int]] = [
+            [i for i, (_, d) in enumerate(edges) if d == nidx]
+            for nidx in range(self.n)
+        ]
+
+
+class DenseState(NamedTuple):
+    """The jit carry. Shapes: N nodes, E edges, C queue slots, S snapshot
+    slots, M recorded messages per (snapshot, edge)."""
+
+    time: Any          # i32 []
+    tokens: Any        # i32 [N]
+    q_marker: Any      # bool [E, C]
+    q_data: Any        # i32 [E, C]   token amount | snapshot id
+    q_rtime: Any       # i32 [E, C]   delivery-eligible time
+    q_head: Any        # i32 [E]
+    q_len: Any         # i32 [E]
+    next_sid: Any      # i32 []
+    started: Any       # bool [S]
+    has_local: Any     # bool [S, N]
+    frozen: Any        # i32 [S, N]   tokens frozen at snapshot creation
+    rem: Any           # i32 [S, N]   links still being recorded
+    done_local: Any    # bool [S, N]
+    recording: Any     # bool [S, E]
+    rec_len: Any       # i32 [S, E]
+    rec_data: Any      # i32 [S, E, M]
+    completed: Any     # i32 [S]      nodes finalized for this snapshot
+    delay_state: Any   # sampler-specific pytree
+    error: Any         # i32 [] sticky bitmask
+
+
+def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseState:
+    """Fresh host-side (numpy) state; jnp conversion happens on first jit call."""
+    n, e = topo.n, topo.e
+    c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
+    i32, b = np.int32, np.bool_
+    return DenseState(
+        time=np.int32(0),
+        tokens=topo.tokens0.copy(),
+        q_marker=np.zeros((e, c), b),
+        q_data=np.zeros((e, c), i32),
+        q_rtime=np.zeros((e, c), i32),
+        q_head=np.zeros(e, i32),
+        q_len=np.zeros(e, i32),
+        next_sid=np.int32(0),
+        started=np.zeros(s, b),
+        has_local=np.zeros((s, n), b),
+        frozen=np.zeros((s, n), i32),
+        rem=np.zeros((s, n), i32),
+        done_local=np.zeros((s, n), b),
+        recording=np.zeros((s, e), b),
+        rec_len=np.zeros((s, e), i32),
+        rec_data=np.zeros((s, e, m), i32),
+        completed=np.zeros(s, i32),
+        delay_state=delay_state,
+        error=np.int32(0),
+    )
+
+
+def decode_snapshot(topo: DenseTopology, host: DenseState, sid: int) -> GlobalSnapshot:
+    """Array state -> GlobalSnapshot, the reference's CollectSnapshot
+    (sim.go:134-173) as a pure gather: token map from the frozen balances,
+    messages per node over its inbound edges in src-rank order, each edge's
+    recordings in arrival order (golden-compatible, test_common.go:253-284)."""
+    token_map = {nid: int(host.frozen[sid, i]) for i, nid in enumerate(topo.ids)}
+    messages: List[MsgSnapshot] = []
+    for nidx, nid in enumerate(topo.ids):
+        for eidx in topo.in_edges[nidx]:
+            src = topo.ids[int(topo.edge_src[eidx])]
+            for j in range(int(host.rec_len[sid, eidx])):
+                messages.append(MsgSnapshot(
+                    src, nid, Message(is_marker=False,
+                                      data=int(host.rec_data[sid, eidx, j]))))
+    return GlobalSnapshot(sid, token_map, messages)
+
+
+def decode_errors(error_bits: int) -> List[str]:
+    return [msg for bit, msg in ERROR_NAMES.items() if error_bits & bit]

@@ -33,6 +33,8 @@ class GoExactDelay(DelayModel):
     """
 
     def __init__(self, seed: int, max_delay: int = MAX_DELAY, **gorand_kwargs):
+        self.seed = seed
+        self.gorand_kwargs = gorand_kwargs
         self.rng = GoRand(seed, **gorand_kwargs)
         self.max_delay = max_delay
 
@@ -47,6 +49,7 @@ class FixedDelay(DelayModel):
         if delay < 1:
             raise ValueError("delay must be >= 1 (messages are never delivered same-tick)")
         self.delay = delay
+        self.max_delay = delay
 
     def receive_time(self, now: int) -> int:
         return now + self.delay

@@ -1,0 +1,121 @@
+"""JAX-side message-delay samplers for the dense/batched kernels.
+
+The delay model is the seam between the bit-exact path and the fast batched
+path (SURVEY.md §5): the reference's only nondeterminism is
+``receiveTime = time + 1 + rand.Intn(maxDelay)`` drawn from Go's global PRNG
+(reference sim.go:100-102, snapshot_test.go:20).
+
+Each sampler carries its own state inside the simulation pytree and exposes
+``draw(dstate, time) -> (receive_time, dstate)`` callable under jit:
+
+  - GoExactJaxDelay    bit-exact Go stream (draw-order sensitive, needs x64)
+  - FixedJaxDelay      constant delay (unit tests, docs)
+  - UniformJaxDelay    counter-based threefry uniform {1..max_delay} — same
+                       distribution as the reference, different stream; the
+                       fast path for batched/TPU runs (no x64 needed)
+
+``from_host_model`` maps the host-side models (models/delay.py) to their JAX
+twins so ``DenseSim`` accepts the same DelayModel objects as the parity
+backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from chandy_lamport_tpu.config import MAX_DELAY
+from chandy_lamport_tpu.models.delay import (
+    DelayModel,
+    FixedDelay,
+    GoExactDelay,
+)
+from chandy_lamport_tpu.ops import gorand_jax
+
+
+class JaxDelay:
+    """Protocol: stateless sampler object, state lives in the sim pytree.
+    ``max_delay`` bounds the sampled delay — it sizes the post-drain flush
+    (test_common.go:135-137 flushes maxDelay+1 ticks)."""
+
+    max_delay: int
+
+    def init_state(self) -> Any:
+        raise NotImplementedError
+
+    def draw(self, dstate: Any, time: jnp.ndarray) -> Tuple[jnp.ndarray, Any]:
+        raise NotImplementedError
+
+
+class GoExactJaxDelay(JaxDelay):
+    """Bit-exact reference delays (reference sim.go:100-102) under jit.
+
+    Seeding happens on the host via ops/gorand.py (which owns the vendored
+    rngCooked table); the seeded generator state is carried as
+    ``(vec u64[607], tap, feed)``. Requires jax_enable_x64.
+    """
+
+    def __init__(self, host_rng_seed: int, max_delay: int = MAX_DELAY, **gorand_kwargs):
+        from chandy_lamport_tpu.ops.gorand import GoRand
+
+        self._host = GoRand(host_rng_seed, **gorand_kwargs)
+        self.max_delay = max_delay
+
+    def init_state(self):
+        gorand_jax.require_x64()
+        vec, tap, feed = self._host.state_arrays()
+        return (jnp.asarray(vec, jnp.uint64), jnp.int32(tap), jnp.int32(feed))
+
+    def draw(self, dstate, time):
+        d, dstate = gorand_jax.intn(dstate, self.max_delay)
+        return time + 1 + d, dstate
+
+
+class FixedJaxDelay(JaxDelay):
+    def __init__(self, delay: int = 1):
+        if delay < 1:
+            raise ValueError("delay must be >= 1")
+        self.delay = delay
+        self.max_delay = delay
+
+    def init_state(self):
+        return ()
+
+    def draw(self, dstate, time):
+        return time + self.delay, dstate
+
+
+class UniformJaxDelay(JaxDelay):
+    """Uniform delay in {1..max_delay}, counter-based ``jax.random`` stream.
+
+    Distribution-identical to the reference's ``1 + Intn(maxDelay)`` but a
+    different stream — the fast path for batched TPU runs. vmap-safe: fold a
+    distinct instance id into the seed per lane.
+    """
+
+    def __init__(self, seed: int, max_delay: int = MAX_DELAY):
+        self.seed = seed
+        self.max_delay = max_delay
+
+    def init_state(self):
+        return jax.random.PRNGKey(self.seed)
+
+    def draw(self, dstate, time):
+        key, sub = jax.random.split(dstate)
+        d = jax.random.randint(sub, (), 0, self.max_delay, dtype=jnp.int32)
+        return time + 1 + d, key
+
+
+def from_host_model(model: DelayModel) -> JaxDelay:
+    """Map a host-side DelayModel to its JAX twin (same stream where the
+    model is reproducible: GoExactDelay re-seeds a fresh GoRand from the
+    recorded seed, FixedDelay is stateless)."""
+    if isinstance(model, GoExactDelay):
+        return GoExactJaxDelay(model.seed, model.max_delay, **model.gorand_kwargs)
+    if isinstance(model, FixedDelay):
+        return FixedJaxDelay(model.delay)
+    raise TypeError(
+        f"no JAX twin for delay model {type(model).__name__}; "
+        "pass a JaxDelay directly")

@@ -1,0 +1,81 @@
+"""Go ``math/rand`` as pure JAX functions — the PRNG-in-carry for jit kernels.
+
+The host-side twin (ops/gorand.py) owns seeding and the vendored rngCooked
+table; this module only advances an already-seeded state under ``jit``:
+state = ``(vec u64[607], tap i32, feed i32)`` exported by
+``GoRand.state_arrays()``.
+
+Semantics replicated from the reference's randomness root (the only PRNG in
+the system, reference sim.go:100-102):
+  - Uint64: 607-lag/273-tap additive lagged Fibonacci over Z/2^64 — tap and
+    feed decrement mod 607, ``vec[feed] += vec[tap]``, return ``vec[feed]``.
+  - Int63 = Uint64 & (2^63-1); Int31 = Int63 >> 32.
+  - Int31n(n): power-of-two fast path, else rejection-sample
+    (reject v > 2^31-1 - 2^31 % n) then ``v % n``. For the reference's only
+    call site, ``Intn(5)``, rejection fires with probability 3/2^31.
+
+Requires ``jax_enable_x64`` (uint64 arithmetic). The fast batched path uses
+counter-based ``jax.random`` instead (ops/delay_jax.py) and needs no x64.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+LEN = 607
+
+GoRandState = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # (vec, tap, feed)
+
+
+def require_x64() -> None:
+    """The lagged-Fibonacci recurrence is over Z/2^64; without x64 JAX
+    silently truncates to uint32 and the stream (and every golden) diverges."""
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "the bit-exact Go PRNG path requires 64-bit integers: call "
+            "jax.config.update('jax_enable_x64', True) before building the "
+            "kernel (the fast batched path, ops/delay_jax.UniformJaxDelay, "
+            "does not need x64)")
+
+
+def uint64(state: GoRandState) -> Tuple[jnp.ndarray, GoRandState]:
+    """One lagged-Fibonacci step; uint64 addition wraps mod 2^64 natively."""
+    vec, tap, feed = state
+    tap = (tap - 1) % LEN
+    feed = (feed - 1) % LEN
+    x = vec[feed] + vec[tap]
+    vec = vec.at[feed].set(x)
+    return x, (vec, tap, feed)
+
+
+def _int31(state: GoRandState) -> Tuple[jnp.ndarray, GoRandState]:
+    x, state = uint64(state)
+    v = ((x & jnp.uint64((1 << 63) - 1)) >> jnp.uint64(32)).astype(jnp.int32)
+    return v, state
+
+
+def intn(state: GoRandState, n: int) -> Tuple[jnp.ndarray, GoRandState]:
+    """Go ``Intn(n)`` for static python ``0 < n < 2^31``."""
+    if not 0 < n < (1 << 31):
+        raise ValueError(f"intn requires 0 < n < 2^31, got {n}")
+    if n & (n - 1) == 0:
+        v, state = _int31(state)
+        return v & (n - 1), state
+    vmax = jnp.int32((1 << 31) - 1 - (1 << 31) % n)
+    v, state = _int31(state)
+
+    def cond(carry):
+        v, _ = carry
+        return v > vmax
+
+    def body(carry):
+        _, s = carry
+        return _int31(s)
+
+    v, state = lax.while_loop(cond, body, (v, state))
+    return v % n, state

@@ -1,0 +1,264 @@
+"""The jitted simulation kernel: tick, event injection, drain.
+
+This is the reference's hot loop (sim.go:71-95) plus the protocol handlers
+(node.go:140-212) as one pure state transition over the dense arrays of
+core/state.py. The five bit-exactness-critical rules (SURVEY.md §7.0) map to:
+
+  R1 lexicographic order   -> node index = lexicographic rank; edges sorted
+                              by (src, dest); all loops are index order.
+  R2 one-delivery-per-source-per-tick, sequential fold with mid-tick marker
+     cascades visible to later sources (sim.go:76-92)
+                           -> ``lax.scan`` over source indices inside the
+                              tick; within a source, the first eligible queue
+                              head in dest order is a masked argmax over its
+                              padded edge row (scan past ineligible heads,
+                              deliver at most one — sim.go:82-92).
+  R3 per-channel FIFO + head-of-line blocking
+                           -> ring buffers popped only at q_head.
+  R4 PRNG draw order       -> delay draws happen exactly where the reference
+                              draws (one per send node.go:130; one per
+                              outbound link in dest order on broadcast
+                              node.go:98-107), sequenced by ``lax.fori_loop``
+                              /``lax.cond`` so skipped branches draw nothing.
+  R5 snapshot id = allocation order (sim.go:107-108)
+                           -> slot index == snapshot id.
+
+Everything is shape-static; the topology is baked into the jitted closures as
+constants. Batched execution vmaps these same functions over a leading
+instance axis (parallel/batch.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import (
+    DenseState,
+    DenseTopology,
+    ERR_QUEUE_OVERFLOW,
+    ERR_RECORD_OVERFLOW,
+    ERR_SNAPSHOT_OVERFLOW,
+    ERR_TICK_LIMIT,
+    ERR_TOKEN_UNDERFLOW,
+)
+from chandy_lamport_tpu.ops.delay_jax import JaxDelay
+
+_i32 = jnp.int32
+
+
+class TickKernel:
+    """Jitted closures over a fixed (topology, config, delay sampler).
+
+    Public jitted entry points (all take/return DenseState):
+      tick(s)                 one simulation tick (sim.go:71-95)
+      run_ticks(s, n)         n ticks under one dispatch
+      inject_send(s, e, amt)  PassTokenEvent on edge e (node.go:112-131)
+      inject_snapshot(s, nd)  SnapshotEvent at node nd (sim.go:105-123)
+      drain_and_flush(s)      tick until every started snapshot completes,
+                              then max_delay+1 flush ticks (test_common.go:124-137)
+    """
+
+    def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay):
+        self.topo = topo
+        self.cfg = cfg
+        self.delay = delay
+        # static topology constants baked into the traces
+        self._edge_src = jnp.asarray(topo.edge_src)
+        self._edge_dst = jnp.asarray(topo.edge_dst)
+        self._edge_table = jnp.asarray(topo.edge_table)
+        self._in_degree = jnp.asarray(topo.in_degree)
+
+        self.tick = jax.jit(self._tick, donate_argnums=0)
+        self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
+        self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
+        self.inject_snapshot = jax.jit(self._inject_snapshot, donate_argnums=0)
+        self.drain_and_flush = jax.jit(self._drain_and_flush, donate_argnums=0)
+
+    # ---- queue primitives ------------------------------------------------
+
+    def _push(self, s: DenseState, e, is_marker: bool, data) -> DenseState:
+        """Append to edge e's ring buffer with one delay draw
+        (node.go:126-130 / node.go:104-108)."""
+        rtime, dstate = self.delay.draw(s.delay_state, s.time)
+        C = self.cfg.queue_capacity
+        pos = (s.q_head[e] + s.q_len[e]) % C
+        err = s.error | jnp.where(s.q_len[e] >= C, ERR_QUEUE_OVERFLOW, 0).astype(_i32)
+        return s._replace(
+            q_marker=s.q_marker.at[e, pos].set(is_marker),
+            q_data=s.q_data.at[e, pos].set(jnp.asarray(data, _i32)),
+            q_rtime=s.q_rtime.at[e, pos].set(jnp.asarray(rtime, _i32)),
+            q_len=s.q_len.at[e].add(1),
+            delay_state=dstate,
+            error=err,
+        )
+
+    # ---- protocol handlers (node.go) ------------------------------------
+
+    def _create_local(self, s: DenseState, sid, node, exclude_edge) -> DenseState:
+        """CreateLocalSnapshot (node.go:58-84): freeze tokens, record all
+        inbound links except the marker's own (exclude_edge == -1 for the
+        initiator case)."""
+        E = self.topo.e
+        inbound = self._edge_dst == node
+        rec_mask = inbound & (jnp.arange(E, dtype=_i32) != exclude_edge)
+        links = self._in_degree[node] - jnp.asarray(exclude_edge >= 0, _i32)
+        return s._replace(
+            has_local=s.has_local.at[sid, node].set(True),
+            frozen=s.frozen.at[sid, node].set(s.tokens[node]),
+            rem=s.rem.at[sid, node].set(links),
+            recording=s.recording.at[sid].set(
+                jnp.where(rec_mask, True, s.recording[sid])),
+        )
+
+    def _broadcast_markers(self, s: DenseState, node, sid) -> DenseState:
+        """SendToNeighbors (node.go:97-109): marker on every outbound link in
+        dest order, one delay draw per real link (padding slots draw nothing)."""
+        def body(k, s):
+            e = self._edge_table[node, k]
+            return lax.cond(e >= 0,
+                            lambda s: self._push(s, e, True, sid),
+                            lambda s: s, s)
+        return lax.fori_loop(0, self.topo.d, body, s)
+
+    def _finalize_check(self, s: DenseState, sid, node) -> DenseState:
+        """finalizeSnapshot + NotifyCompletedSnapshot when no links remain
+        recording (node.go:165-170). The message flattening itself is a
+        decode-time gather — rec_data is already per-edge in arrival order."""
+        fire = (s.has_local[sid, node] & (s.rem[sid, node] == 0)
+                & ~s.done_local[sid, node])
+        return s._replace(
+            done_local=s.done_local.at[sid, node].set(
+                s.done_local[sid, node] | fire),
+            completed=s.completed.at[sid].add(jnp.asarray(fire, _i32)),
+        )
+
+    def _handle_marker(self, s: DenseState, e, sid) -> DenseState:
+        """HandleMarker (node.go:149-171). First marker for sid at this node:
+        create the local snapshot excluding the marker's link, then re-broadcast
+        (node.StartSnapshot, node.go:198-212). Repeat marker: stop recording
+        that link. Either way, check finalization after (R8)."""
+        dst = self._edge_dst[e]
+
+        def first(s):
+            s = self._create_local(s, sid, dst, e)
+            return self._broadcast_markers(s, dst, sid)
+
+        def repeat(s):
+            return s._replace(
+                recording=s.recording.at[sid, e].set(False),
+                rem=s.rem.at[sid, dst].add(-1),
+            )
+
+        s = lax.cond(~s.has_local[sid, dst], first, repeat, s)
+        return self._finalize_check(s, sid, dst)
+
+    def _handle_token(self, s: DenseState, e, amount) -> DenseState:
+        """HandleToken (node.go:174-185): credit the destination, then append
+        the amount to every snapshot slot still recording this edge —
+        vectorized over all S slots at once."""
+        S, M = self.cfg.max_snapshots, self.cfg.max_recorded
+        dst = self._edge_dst[e]
+        cond = s.recording[:, e]                       # [S]
+        pos = jnp.clip(s.rec_len[:, e], 0, M - 1)      # [S]
+        rows = jnp.arange(S)
+        col = s.rec_data[:, e, :]                      # [S, M]
+        col = col.at[rows, pos].set(
+            jnp.where(cond, jnp.asarray(amount, _i32), col[rows, pos]))
+        err = s.error | jnp.where(
+            jnp.any(cond & (s.rec_len[:, e] >= M)), ERR_RECORD_OVERFLOW, 0
+        ).astype(_i32)
+        return s._replace(
+            tokens=s.tokens.at[dst].add(jnp.asarray(amount, _i32)),
+            rec_data=s.rec_data.at[:, e, :].set(col),
+            rec_len=s.rec_len.at[:, e].add(cond.astype(_i32)),
+            error=err,
+        )
+
+    def _deliver(self, s: DenseState, e) -> DenseState:
+        """Pop edge e's head and dispatch (HandlePacket, node.go:140-146)."""
+        C = self.cfg.queue_capacity
+        slot = s.q_head[e]
+        is_marker = s.q_marker[e, slot]
+        data = s.q_data[e, slot]
+        s = s._replace(q_head=s.q_head.at[e].set((slot + 1) % C),
+                       q_len=s.q_len.at[e].add(-1))
+        return lax.cond(is_marker,
+                        lambda s: self._handle_marker(s, e, data),
+                        lambda s: self._handle_token(s, e, data), s)
+
+    # ---- the tick (sim.go:71-95) ----------------------------------------
+
+    def _tick(self, s: DenseState) -> DenseState:
+        s = s._replace(time=s.time + 1)
+
+        def per_source(s, n):
+            edges = self._edge_table[n]                     # [D], -1 padded
+            valid = edges >= 0
+            safe = jnp.where(valid, edges, 0)
+            heads = s.q_head[safe]
+            rts = s.q_rtime[safe, heads]
+            elig = valid & (s.q_len[safe] > 0) & (rts <= s.time)
+            found = jnp.any(elig)
+            e = safe[jnp.argmax(elig)]                      # first in dest order
+            s = lax.cond(found, lambda s: self._deliver(s, e), lambda s: s, s)
+            return s, None
+
+        s, _ = lax.scan(per_source, s, jnp.arange(self.topo.n, dtype=_i32))
+        return s
+
+    def _run_ticks(self, s: DenseState, n) -> DenseState:
+        """n is a traced i32 so every distinct ``tick N`` count shares one
+        compilation (fori_loop lowers to while_loop for dynamic bounds)."""
+        return lax.fori_loop(jnp.int32(0), jnp.asarray(n, _i32),
+                             lambda _, s: self._tick(s), s)
+
+    # ---- event injection (sim.go:58-68) ---------------------------------
+
+    def _inject_send(self, s: DenseState, e, amount) -> DenseState:
+        """PassTokenEvent -> SendTokens (node.go:112-131): debit at send time,
+        one delay draw, enqueue."""
+        src = self._edge_src[e]
+        err = s.error | jnp.where(
+            s.tokens[src] < amount, ERR_TOKEN_UNDERFLOW, 0).astype(_i32)
+        s = s._replace(tokens=s.tokens.at[src].add(-jnp.asarray(amount, _i32)),
+                       error=err)
+        return self._push(s, e, False, amount)
+
+    def _inject_snapshot(self, s: DenseState, node) -> DenseState:
+        """SnapshotEvent -> sim.StartSnapshot (sim.go:105-123): allocate the
+        next id, create the initiator's local snapshot recording ALL inbound
+        links, broadcast markers. No finalize check here (the reference only
+        checks on marker receipt)."""
+        S = self.cfg.max_snapshots
+        sid = s.next_sid
+        err = s.error | jnp.where(sid >= S, ERR_SNAPSHOT_OVERFLOW, 0).astype(_i32)
+        sid = jnp.clip(sid, 0, S - 1)
+        s = s._replace(next_sid=s.next_sid + 1,
+                       started=s.started.at[sid].set(True),
+                       error=err)
+        s = self._create_local(s, sid, node, jnp.int32(-1))
+        return self._broadcast_markers(s, node, sid)
+
+    # ---- drain (test_common.go:124-137) ---------------------------------
+
+    def _pending(self, s: DenseState):
+        return jnp.any(s.started & (s.completed < self.topo.n))
+
+    def _drain_and_flush(self, s: DenseState) -> DenseState:
+        """Tick until every started snapshot has completed on all nodes, then
+        max_delay+1 flush ticks. Outcome-equivalent to the reference's
+        goroutine drain loop (SURVEY.md §3.5), with a tick-budget guard in
+        place of hanging on a non-strongly-connected graph."""
+        limit = jnp.asarray(s.time + self.cfg.max_ticks, _i32)
+
+        def cond(s):
+            return self._pending(s) & (s.time < limit)
+
+        s = lax.while_loop(cond, self._tick, s)
+        s = s._replace(error=s.error | jnp.where(
+            self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
+        return lax.fori_loop(0, self.cfg.max_delay + 1,
+                             lambda _, s: self._tick(s), s)

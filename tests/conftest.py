@@ -9,11 +9,19 @@ run against ``--xla_force_host_platform_device_count=8`` CPU devices instead
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: this environment exports JAX_PLATFORMS=axon (the real TPU
+# tunnel); tests must never land on the single real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# The bit-exact Go-PRNG path needs 64-bit integers under jit.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The bit-exact Go-PRNG path needs 64-bit integers under jit. The env-var
+# route (JAX_ENABLE_X64) is unreliable here because the environment's TPU
+# plugin can initialize jax.config before test code runs; the programmatic
+# switch always works.
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

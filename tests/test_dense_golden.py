@@ -1,0 +1,26 @@
+"""The 7 reference golden tests (snapshot_test.go:46-108) through the dense
+JAX backend — the gate for SURVEY.md §7.2.4: bit-identical snapshots to the
+Go reference via the jitted tick kernel."""
+
+import pytest
+
+from chandy_lamport_tpu.api import run_events_file
+from chandy_lamport_tpu.utils.compare import (
+    assert_snapshots_equal,
+    check_tokens,
+    sort_snapshots,
+)
+from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+
+
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=[t[1].removesuffix(".events") for t in REFERENCE_TESTS])
+def test_golden_dense(top, events, snaps):
+    actual, sim = run_events_file(fixture_path(top), fixture_path(events),
+                                  backend="jax")
+    assert len(actual) == len(snaps)
+    check_tokens(sim.node_tokens(), actual)
+    expected = [read_snapshot_file(fixture_path(f)) for f in snaps]
+    for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+        assert_snapshots_equal(e, a)
