@@ -1,0 +1,180 @@
+"""Batched execution: vmap over independent simulation instances.
+
+This is the framework's data-parallel axis (SURVEY.md §2.5): the reference
+simulates ONE system per process; here a whole event script — sends,
+snapshot initiations, ticks, drain, flush — compiles into a single XLA
+program executed over B instances in lockstep by ``vmap``. Per-instance
+divergence (different delay streams → different delivery schedules →
+different drain lengths) is handled by the batching rules of
+``lax.while_loop``/``lax.cond``: lanes that finish early idle until the
+slowest lane converges.
+
+Script compilation (``compile_events``): the reference executes events
+imperatively between ticks (test_common.go:79-140). Here the script becomes
+dense op tensors — ``kind/arg0/arg1 [T, K]`` where each phase t carries up to
+K ops (0=nop, 1=send(edge, amount), 2=snapshot(node)) followed by exactly one
+tick — and the whole run is ``lax.scan`` over phases. Op order within a phase
+is preserved (script order = PRNG draw order = bit-exactness rule R4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import (
+    Event,
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.core.state import DenseState, DenseTopology, init_state
+from chandy_lamport_tpu.ops.delay_jax import JaxDelay, UniformJaxDelay
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
+
+
+class ScriptOps(NamedTuple):
+    """A compiled event script: T phases of up to K ops, one tick per phase."""
+
+    kind: Any   # i32 [T, K]
+    arg0: Any   # i32 [T, K]  edge index (send) | node index (snapshot)
+    arg1: Any   # i32 [T, K]  token amount (send)
+
+    @property
+    def num_phases(self) -> int:
+        return self.kind.shape[0]
+
+
+def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
+    """Events -> dense op tensors. Each ``tick n`` closes the current phase
+    and appends n-1 empty phases; trailing non-tick events get a final phase
+    (its tick is outcome-equivalent to the first drain tick, SURVEY.md §3.5)."""
+    phases: List[List[tuple]] = []
+    cur: List[tuple] = []
+    for ev in events:
+        if isinstance(ev, PassTokenEvent):
+            src, dest = topo.index[ev.src], topo.index[ev.dest]
+            e = topo.edge_index.get((src, dest))
+            if e is None:
+                raise ValueError(f"no link {ev.src} -> {ev.dest}")
+            cur.append((OP_SEND, e, ev.tokens))
+        elif isinstance(ev, SnapshotEvent):
+            cur.append((OP_SNAPSHOT, topo.index[ev.node_id], 0))
+        elif isinstance(ev, TickEvent):
+            phases.append(cur)
+            cur = []
+            for _ in range(ev.n - 1):
+                phases.append([])
+        else:
+            raise TypeError(f"unknown event: {ev!r}")
+    if cur:
+        phases.append(cur)
+    t = max(len(phases), 1)
+    k = max((len(p) for p in phases), default=0) or 1
+    kind = np.zeros((t, k), np.int32)
+    arg0 = np.zeros((t, k), np.int32)
+    arg1 = np.zeros((t, k), np.int32)
+    for i, ops in enumerate(phases):
+        for j, (op, a0, a1) in enumerate(ops):
+            kind[i, j], arg0[i, j], arg1[i, j] = op, a0, a1
+    return ScriptOps(kind, arg0, arg1)
+
+
+class BatchedRunner:
+    """Runs a compiled script over B vmapped instances, fully under one jit.
+
+    The delay sampler should be per-instance (``UniformJaxDelay`` folds the
+    lane index into its key); a shared GoExact stream would make every lane
+    identical — valid for testing, pointless for throughput.
+    """
+
+    def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
+                 delay: JaxDelay, batch: int):
+        self.topo = DenseTopology(topology)
+        self.config = config or SimConfig()
+        self.delay = delay
+        self.batch = batch
+        self.kernel = TickKernel(self.topo, self.config, self.delay)
+        self._run = jax.jit(
+            jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
+        self._run_no_drain = jax.jit(
+            jax.vmap(self._run_single_no_drain, in_axes=(0, None)),
+            donate_argnums=0)
+
+    # -- state construction ------------------------------------------------
+
+    def init_batch(self) -> DenseState:
+        """Fresh batched state: sim arrays broadcast over B, delay state
+        built per-lane."""
+        single = init_state(self.topo, self.config, None)
+        batched = jax.tree_util.tree_map(
+            lambda x: np.broadcast_to(np.asarray(x), (self.batch,) + np.shape(x)).copy(),
+            single._replace(delay_state=()))
+        return batched._replace(delay_state=self._batched_delay_state())
+
+    def _batched_delay_state(self):
+        if isinstance(self.delay, UniformJaxDelay):
+            base = jax.random.PRNGKey(self.delay.seed)
+            return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(self.batch, dtype=jnp.uint32))
+        one = self.delay.init_state()
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (self.batch,) + jnp.shape(x)), one)
+
+    # -- execution ---------------------------------------------------------
+
+    def _apply_phase(self, s: DenseState, ops) -> DenseState:
+        kind, arg0, arg1 = ops
+
+        def body(j, s):
+            return lax.switch(kind[j], [
+                lambda s: s,
+                lambda s: self.kernel._inject_send(s, arg0[j], arg1[j]),
+                lambda s: self.kernel._inject_snapshot(s, arg0[j]),
+            ], s)
+
+        s = lax.fori_loop(0, kind.shape[0], body, s)
+        return self.kernel._tick(s)
+
+    def _run_single_no_drain(self, s: DenseState, script: ScriptOps) -> DenseState:
+        def phase(s, ops):
+            return self._apply_phase(s, ops), None
+
+        s, _ = lax.scan(phase, s, tuple(script))
+        return s
+
+    def _run_single(self, s: DenseState, script: ScriptOps) -> DenseState:
+        s = self._run_single_no_drain(s, script)
+        return self.kernel._drain_and_flush(s)
+
+    def run(self, state: DenseState, script: ScriptOps,
+            drain: bool = True) -> DenseState:
+        """One dispatch: inject + tick every phase, then (optionally) drain
+        until all lanes' snapshots complete + flush."""
+        fn = self._run if drain else self._run_no_drain
+        return fn(state, ScriptOps(*map(jnp.asarray, script)))
+
+    # -- aggregate metrics (jit-friendly reductions; under a sharded batch
+    #    axis these lower to XLA collectives over ICI) --------------------
+
+    @staticmethod
+    def summarize(state: DenseState) -> dict:
+        return {
+            "instances": int(state.time.shape[0]),
+            "total_ticks": int(jnp.sum(state.time)),
+            "max_time": int(jnp.max(state.time)),
+            "error_lanes": int(jnp.sum(state.error != 0)),
+            "snapshots_started": int(jnp.sum(state.started)),
+            "snapshots_completed": int(jnp.sum(
+                jnp.sum(state.started & (state.completed >= state.has_local.shape[-1]),
+                        axis=-1))),
+            "total_tokens_resident": int(jnp.sum(state.tokens)),
+        }

@@ -10,7 +10,10 @@ import os
 import sys
 
 # Unconditional: this environment exports JAX_PLATFORMS=axon (the real TPU
-# tunnel); tests must never land on the single real chip.
+# tunnel); tests must never land on the single real chip. The env var alone
+# is NOT enough — pytest plugins can import jax before this conftest runs,
+# by which point jax.config has already read the environment — so the
+# platform is also forced through jax.config below.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -24,4 +27,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # switch always works.
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, not the real TPU chip")
+assert len(jax.devices()) >= 8, (
+    "xla_force_host_platform_device_count=8 did not take effect "
+    "(XLA backends were initialized before conftest ran?)")
