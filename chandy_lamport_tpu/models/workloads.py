@@ -1,0 +1,120 @@
+"""Synthetic topologies and traffic programs for scale testing + benchmarks.
+
+The reference ships 4 hand-written topologies capped at 10 nodes
+(test_data/, SURVEY.md §4.3); the BASELINE.md config ladder needs graphs at
+256-8k nodes. All generators embed a Hamiltonian ring so every graph is
+strongly connected — snapshot completion requires reaching every node
+(reference sim.go:116-117 waits on ALL nodes).
+
+A ``StormProgram`` is the scale analogue of 10nodes.events (every tick, every
+node sends tokens ahead; snapshots staggered over ticks): per phase, every
+node sends on one outbound edge (round-robin over its out-links, so the
+whole phase is one vectorized bulk_send), and snapshot initiations fire on a
+schedule. Executed by ``BatchedRunner.run_storm`` fully under jit.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from chandy_lamport_tpu.core.state import DenseTopology
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+
+def _ids(n: int) -> List[str]:
+    # zero-padded so lexicographic order == numeric order at any scale
+    width = len(str(n))
+    return [f"N{str(i + 1).zfill(width)}" for i in range(n)]
+
+
+def ring_topology(n: int, tokens: int = 100) -> TopologySpec:
+    """Unidirectional ring — the shape of the reference's largest fixture
+    (10nodes.top) at arbitrary scale."""
+    ids = _ids(n)
+    nodes = [(nid, tokens) for nid in ids]
+    links = [(ids[i], ids[(i + 1) % n]) for i in range(n)]
+    return TopologySpec(nodes, links)
+
+
+def erdos_renyi(n: int, avg_degree: float, seed: int,
+                tokens: int = 100) -> TopologySpec:
+    """Ring + uniformly random extra arcs up to the requested mean
+    out-degree (BASELINE.md config 3)."""
+    rng = random.Random(seed)
+    ids = _ids(n)
+    nodes = [(nid, tokens) for nid in ids]
+    links = {(ids[i], ids[(i + 1) % n]) for i in range(n)}
+    extra = max(0, int(n * avg_degree) - n)
+    while len(links) < n + extra:
+        a, b = rng.randrange(n), rng.randrange(n)
+        if a != b:
+            links.add((ids[a], ids[b]))
+    return TopologySpec(nodes, sorted(links))
+
+
+def scale_free(n: int, attach: int, seed: int,
+               tokens: int = 100) -> TopologySpec:
+    """Ring + preferential attachment (Barabási–Albert flavor): each node
+    adds ``attach`` outbound arcs to degree-weighted targets
+    (BASELINE.md config 4 — hubs concentrate traffic, stressing the
+    per-edge queues unevenly)."""
+    rng = random.Random(seed)
+    ids = _ids(n)
+    nodes = [(nid, tokens) for nid in ids]
+    links = {(ids[i], ids[(i + 1) % n]) for i in range(n)}
+    degree = [1] * n
+    targets = list(range(n))  # degree-weighted sampling pool
+    for i in range(n):
+        for _ in range(attach):
+            j = targets[rng.randrange(len(targets))]
+            if j != i and (ids[i], ids[j]) not in links:
+                links.add((ids[i], ids[j]))
+                degree[j] += 1
+                targets.append(j)
+    return TopologySpec(nodes, sorted(links))
+
+
+class StormProgram(NamedTuple):
+    """Compiled storm traffic: T phases, each = bulk sends + snapshot
+    initiations + one tick."""
+
+    amounts: Any   # i32 [T, E] tokens to send on each edge this phase
+    snap: Any      # i32 [T, J] initiator node index, -1 = none
+
+
+def storm_program(topo: DenseTopology, phases: int, amount: int = 1,
+                  snapshot_phases: Optional[Sequence[Tuple[int, int]]] = None,
+                  ) -> StormProgram:
+    """Every phase, every node sends ``amount`` on one outbound edge,
+    cycling round-robin over its out-links; ``snapshot_phases`` is
+    [(phase, node_index), ...]. Initial balances must cover phases*amount
+    per node (generators default to 100; the storm runner checks the
+    underflow flag)."""
+    t, e, n = phases, topo.e, topo.n
+    amounts = np.zeros((t, e), np.int32)
+    out_edges = [list(row[row >= 0]) for row in topo.edge_table]
+    for ph in range(t):
+        for node in range(n):
+            oe = out_edges[node]
+            if oe:
+                amounts[ph, oe[ph % len(oe)]] += amount
+    sched = list(snapshot_phases or [])
+    per_phase: List[List[int]] = [[] for _ in range(t)]
+    for ph, node in sched:
+        per_phase[ph].append(node)
+    j = max((len(p) for p in per_phase), default=0) or 1
+    snap = np.full((t, j), -1, np.int32)
+    for ph, nodes in enumerate(per_phase):
+        snap[ph, :len(nodes)] = nodes
+    return StormProgram(amounts, snap)
+
+
+def staggered_snapshots(topo: DenseTopology, count: int,
+                        start_phase: int = 0, stride: int = 1,
+                        ) -> List[Tuple[int, int]]:
+    """The 10nodes.events pattern: snapshot k initiated by node k at phase
+    start + k*stride."""
+    return [(start_phase + k * stride, k % topo.n) for k in range(count)]

@@ -48,6 +48,19 @@ class JaxDelay:
     def draw(self, dstate: Any, time: jnp.ndarray) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
 
+    def draw_many(self, dstate: Any, time, n: int) -> Tuple[jnp.ndarray, Any]:
+        """n receive times at once (bulk injection fast path). Default is a
+        sequential scan of draw() preserving stream order; counter-based
+        samplers override with one vectorized draw."""
+        from jax import lax
+
+        def step(d, _):
+            rt, d = self.draw(d, time)
+            return d, rt
+
+        dstate, rts = lax.scan(step, dstate, None, length=n)
+        return rts, dstate
+
 
 class GoExactJaxDelay(JaxDelay):
     """Bit-exact reference delays (reference sim.go:100-102) under jit.
@@ -105,6 +118,11 @@ class UniformJaxDelay(JaxDelay):
     def draw(self, dstate, time):
         key, sub = jax.random.split(dstate)
         d = jax.random.randint(sub, (), 0, self.max_delay, dtype=jnp.int32)
+        return time + 1 + d, key
+
+    def draw_many(self, dstate, time, n: int):
+        key, sub = jax.random.split(dstate)
+        d = jax.random.randint(sub, (n,), 0, self.max_delay, dtype=jnp.int32)
         return time + 1 + d, key
 
 

@@ -97,16 +97,35 @@ class BatchedRunner:
     """
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
-                 delay: JaxDelay, batch: int):
+                 delay: JaxDelay, batch: int, scheduler: str = "exact"):
+        """scheduler: 'exact' = the reference's sequential source fold
+        (bit-exact, O(N) sequential steps per tick); 'sync' = simultaneous
+        delivery (deterministic, protocol-equivalent, O(E) vectorized work
+        per tick — the production/benchmark path, ops/tick._sync_tick)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
         self.batch = batch
         self.kernel = TickKernel(self.topo, self.config, self.delay)
+        if scheduler == "exact":
+            self._tick_fn = self.kernel._tick
+            self._drain_fn = self.kernel._drain_and_flush
+        elif scheduler == "sync":
+            self._tick_fn = self.kernel._sync_tick
+            self._drain_fn = self.kernel._sync_drain_and_flush
+        else:
+            raise ValueError(f"unknown scheduler {scheduler!r}")
+        self.scheduler = scheduler
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
         self._run_no_drain = jax.jit(
             jax.vmap(self._run_single_no_drain, in_axes=(0, None)),
+            donate_argnums=0)
+        self._run_storm = jax.jit(
+            jax.vmap(self._run_storm_single, in_axes=(0, None)),
+            donate_argnums=0)
+        self._run_storm_no_drain = jax.jit(
+            jax.vmap(self._run_storm_phases, in_axes=(0, None)),
             donate_argnums=0)
 
     # -- state construction ------------------------------------------------
@@ -142,7 +161,7 @@ class BatchedRunner:
             ], s)
 
         s = lax.fori_loop(0, kind.shape[0], body, s)
-        return self.kernel._tick(s)
+        return self._tick_fn(s)
 
     def _run_single_no_drain(self, s: DenseState, script: ScriptOps) -> DenseState:
         def phase(s, ops):
@@ -153,7 +172,7 @@ class BatchedRunner:
 
     def _run_single(self, s: DenseState, script: ScriptOps) -> DenseState:
         s = self._run_single_no_drain(s, script)
-        return self.kernel._drain_and_flush(s)
+        return self._drain_fn(s)
 
     def run(self, state: DenseState, script: ScriptOps,
             drain: bool = True) -> DenseState:
@@ -161,6 +180,42 @@ class BatchedRunner:
         until all lanes' snapshots complete + flush."""
         fn = self._run if drain else self._run_no_drain
         return fn(state, ScriptOps(*map(jnp.asarray, script)))
+
+    # -- storm programs (models/workloads.py): bulk vectorized sends ------
+
+    def storm_phase(self, s: DenseState, amounts, snaps) -> DenseState:
+        """One storm phase for one instance: bulk sends + scheduled snapshot
+        initiations + one tick. This is the framework's 'forward step'."""
+        s = self.kernel._bulk_send(s, amounts)
+
+        def body(j, s):
+            return lax.cond(snaps[j] >= 0,
+                            lambda s: self.kernel._inject_snapshot(s, snaps[j]),
+                            lambda s: s, s)
+
+        s = lax.fori_loop(0, snaps.shape[-1], body, s)
+        return self._tick_fn(s)
+
+    def _run_storm_phases(self, s: DenseState, program) -> DenseState:
+        amounts, snap = program
+
+        def phase(s, xs):
+            return self.storm_phase(s, xs[0], xs[1]), None
+
+        s, _ = lax.scan(phase, s, (amounts, snap))
+        return s
+
+    def _run_storm_single(self, s: DenseState, program) -> DenseState:
+        s = self._run_storm_phases(s, program)
+        return self._drain_fn(s)
+
+    def run_storm(self, state: DenseState, program,
+                  drain: bool = True) -> DenseState:
+        """Execute a StormProgram (bulk per-edge sends + scheduled snapshot
+        initiations + one tick per phase) over all lanes in one dispatch."""
+        prog = tuple(jnp.asarray(x) for x in program)
+        fn = self._run_storm if drain else self._run_storm_no_drain
+        return fn(state, prog)
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch
     #    axis these lower to XLA collectives over ICI) --------------------
