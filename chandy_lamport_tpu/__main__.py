@@ -1,0 +1,5 @@
+import sys
+
+from chandy_lamport_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,176 @@
+"""Command-line interface — the framework's operational front door.
+
+The reference has no CLI (``go test`` is its only entry point, SURVEY.md §3);
+this covers the same ground and the scale workflows the reference lacks:
+
+  run    execute a .top + .events fixture pair on any backend, print the
+         collected snapshots in .snap format (round-trips through the golden
+         parser)
+  test   run every reference golden case end-to-end and report pass/fail —
+         the CLI twin of the pytest suite
+  storm  batched scale run (instances x storm program) with aggregate
+         metrics, optional checkpointing
+  bench  the node-ticks/sec benchmark (same engine as /bench.py)
+
+Usage: python -m chandy_lamport_tpu <command> [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from chandy_lamport_tpu.config import REFERENCE_TEST_SEED, SimConfig
+
+
+def _cmd_run(args) -> int:
+    from chandy_lamport_tpu.api import run_events_file
+
+    snaps, sim = run_events_file(args.topology, args.events,
+                                 backend=args.backend, seed=args.seed,
+                                 trace=args.trace)
+    for snap in snaps:
+        print(snap.id)
+        for nid in sorted(snap.token_map):
+            print(f"{nid} {snap.token_map[nid]}")
+        for m in snap.messages:
+            print(f"{m.src} {m.dest} {m.message}")
+        print()
+    if args.trace:
+        print(sim.trace.pretty(), file=sys.stderr)
+    return 0
+
+
+def _cmd_test(args) -> int:
+    from chandy_lamport_tpu.api import run_events_file
+    from chandy_lamport_tpu.utils.compare import (
+        SnapshotMismatch,
+        assert_snapshots_equal,
+        check_tokens,
+        sort_snapshots,
+    )
+    from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+    from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+
+    failures = 0
+    for top, events, snaps in REFERENCE_TESTS:
+        name = events.removesuffix(".events")
+        try:
+            actual, sim = run_events_file(fixture_path(top),
+                                          fixture_path(events),
+                                          backend=args.backend)
+            assert len(actual) == len(snaps), (
+                f"{len(actual)} snapshots, expected {len(snaps)}")
+            check_tokens(sim.node_tokens(), actual)
+            expected = [read_snapshot_file(fixture_path(f)) for f in snaps]
+            for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+                assert_snapshots_equal(e, a)
+            print(f"PASS {name}")
+        except (SnapshotMismatch, AssertionError, Exception) as exc:
+            failures += 1
+            print(f"FAIL {name}: {exc}")
+    print(f"{len(REFERENCE_TESTS) - failures}/{len(REFERENCE_TESTS)} passed")
+    return 1 if failures else 0
+
+
+def _cmd_storm(args) -> int:
+    import jax
+
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.metrics import (
+        conservation_delta,
+        progress_counters,
+    )
+
+    gen = {"ring": lambda: ring_topology(args.nodes, tokens=args.phases + 10),
+           "er": lambda: erdos_renyi(args.nodes, 3.0, args.seed,
+                                     tokens=args.phases + 10),
+           "sf": lambda: scale_free(args.nodes, 2, args.seed,
+                                    tokens=args.phases + 10)}[args.graph]
+    spec = gen()
+    cfg = SimConfig(queue_capacity=args.queue_capacity,
+                    max_snapshots=max(8, args.snapshots),
+                    max_recorded=args.max_recorded)
+    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=args.seed),
+                           batch=args.batch, scheduler=args.scheduler)
+    prog = storm_program(
+        runner.topo, phases=args.phases, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2))
+    final = runner.run_storm(runner.init_batch(), prog)
+    jax.block_until_ready(final)
+    counters = {k: int(v) for k, v in progress_counters(
+        final, cfg, runner.topo.n).items()}
+    expected = int(runner.topo.tokens0.sum()) * args.batch
+    counters["conservation_delta"] = int(
+        conservation_delta(final, cfg, expected))
+    if args.checkpoint:
+        from chandy_lamport_tpu.utils.checkpoint import save_state
+
+        save_state(args.checkpoint, final,
+                   meta={"nodes": runner.topo.n, "batch": args.batch,
+                         "scheduler": args.scheduler})
+        counters["checkpoint"] = args.checkpoint
+    print(json.dumps(counters))
+    return 0 if counters["error_bits"] == 0 else 1
+
+
+def _cmd_bench(args) -> int:
+    import runpy
+    import os
+
+    sys.argv = ["bench.py"] + args.bench_args
+    runpy.run_path(os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+                   run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="chandy_lamport_tpu",
+                                description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("run", help="run a .top + .events pair")
+    pr.add_argument("topology")
+    pr.add_argument("events")
+    pr.add_argument("--backend", choices=["parity", "jax"], default="parity")
+    pr.add_argument("--seed", type=int, default=REFERENCE_TEST_SEED + 1)
+    pr.add_argument("--trace", action="store_true")
+    pr.set_defaults(fn=_cmd_run)
+
+    pt = sub.add_parser("test", help="run the reference golden suite")
+    pt.add_argument("--backend", choices=["parity", "jax"], default="parity")
+    pt.set_defaults(fn=_cmd_test)
+
+    ps = sub.add_parser("storm", help="batched scale run")
+    ps.add_argument("--graph", choices=["ring", "er", "sf"], default="sf")
+    ps.add_argument("--nodes", type=int, default=256)
+    ps.add_argument("--batch", type=int, default=128)
+    ps.add_argument("--phases", type=int, default=32)
+    ps.add_argument("--snapshots", type=int, default=8)
+    ps.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--queue-capacity", type=int, default=16)
+    ps.add_argument("--max-recorded", type=int, default=16)
+    ps.add_argument("--checkpoint", help="save final state to this .npz")
+    ps.set_defaults(fn=_cmd_storm)
+
+    pb = sub.add_parser("bench", help="node-ticks/sec benchmark")
+    pb.add_argument("bench_args", nargs=argparse.REMAINDER)
+    pb.set_defaults(fn=_cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,18 +48,24 @@ class JaxDelay:
     def draw(self, dstate: Any, time: jnp.ndarray) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
 
-    def draw_many(self, dstate: Any, time, n: int) -> Tuple[jnp.ndarray, Any]:
-        """n receive times at once (bulk injection fast path). Default is a
-        sequential scan of draw() preserving stream order; counter-based
-        samplers override with one vectorized draw."""
+    def draw_many(self, dstate: Any, time, shape) -> Tuple[jnp.ndarray, Any]:
+        """receive times of the given shape (int or tuple) at once — the bulk
+        injection fast path. Default is a sequential scan of draw()
+        preserving stream order; counter-based samplers override with one
+        vectorized draw."""
         from jax import lax
+
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        n = 1
+        for d in shape:
+            n *= d
 
         def step(d, _):
             rt, d = self.draw(d, time)
             return d, rt
 
         dstate, rts = lax.scan(step, dstate, None, length=n)
-        return rts, dstate
+        return rts.reshape(shape), dstate
 
 
 class GoExactJaxDelay(JaxDelay):
@@ -120,9 +126,10 @@ class UniformJaxDelay(JaxDelay):
         d = jax.random.randint(sub, (), 0, self.max_delay, dtype=jnp.int32)
         return time + 1 + d, key
 
-    def draw_many(self, dstate, time, n: int):
+    def draw_many(self, dstate, time, shape):
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
         key, sub = jax.random.split(dstate)
-        d = jax.random.randint(sub, (n,), 0, self.max_delay, dtype=jnp.int32)
+        d = jax.random.randint(sub, shape, 0, self.max_delay, dtype=jnp.int32)
         return time + 1 + d, key
 
 

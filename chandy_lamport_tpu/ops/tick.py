@@ -72,6 +72,22 @@ class TickKernel:
         self._in_degree = jnp.asarray(topo.in_degree)
 
         self._rows_e = jnp.arange(topo.e, dtype=_i32)
+        # dense constants for the scatter-free sync path: incidence matrices
+        # (graph reductions become MXU matmuls — exact in f32 for counts
+        # < 2^24) and the same-source strict-predecessor matrix for the
+        # first-eligible-per-source selection
+        import numpy as _np
+
+        n, e = topo.n, topo.e
+        a_in = _np.zeros((n, e), _np.float32)
+        a_in[topo.edge_dst, _np.arange(e)] = 1.0   # A_in @ x_e = per-dest sum
+        a_out = _np.zeros((n, e), _np.float32)
+        a_out[topo.edge_src, _np.arange(e)] = 1.0  # A_out @ x_e = per-src sum
+        prior = ((topo.edge_src[None, :] == topo.edge_src[:, None])
+                 & (_np.arange(e)[None, :] < _np.arange(e)[:, None]))
+        self._A_in = jnp.asarray(a_in)
+        self._A_out = jnp.asarray(a_out)
+        self._L_prior = jnp.asarray(prior.astype(_np.float32))
         self.tick = jax.jit(self._tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
@@ -227,97 +243,85 @@ class TickKernel:
         _tick. Cost: O(E + S·E) vectorized work, no N-step sequential fold —
         this is what makes 1M-instance batches fast on TPU.
         """
+        f32 = jnp.float32
         N, E, C = self.topo.n, self.topo.e, self.cfg.queue_capacity
         S, M = self.cfg.max_snapshots, self.cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
-        rows = self._rows_e
+        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
 
-        # choose at most one eligible head per source (first in dest order)
-        heads = s.q_head
-        head_rt = s.q_rtime[rows, heads]
+        # ---- choose + pop: at most one eligible head per source (first in
+        # dest order). Head reads are one-hot sums over the capacity axis;
+        # "no earlier eligible edge of the same source" is a constant-matrix
+        # matmul — zero dynamic-index gathers/scatters in the whole tick.
+        head_hit = cc == s.q_head[:, None]                        # [E, C]
+        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
+        popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
+        popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
         elig_e = (s.q_len > 0) & (head_rt <= time)                # [E]
-        et = self._edge_table                                     # [N, D]
-        valid_t = et >= 0
-        safe_t = jnp.where(valid_t, et, 0)
-        elig_t = valid_t & elig_e[safe_t]                         # [N, D]
-        found_n = jnp.any(elig_t, axis=1)
-        first_k = jnp.argmax(elig_t, axis=1)
-        chosen_e = safe_t[jnp.arange(N), first_k]                 # [N]
-        deliver_e = jnp.zeros(E, bool).at[chosen_e].max(found_n)  # [E]
-
-        # pop all chosen heads at once
-        popped_marker = s.q_marker[rows, heads]
-        popped_data = s.q_data[rows, heads]
+        prior = self._L_prior @ elig_e.astype(f32)                # [E]
+        deliver_e = elig_e & (prior < 0.5)
         s = s._replace(
-            q_head=jnp.where(deliver_e, (heads + 1) % C, heads),
+            q_head=(s.q_head + deliver_e) % C,
             q_len=s.q_len - deliver_e.astype(_i32),
         )
 
-        # token deliveries: credit + record into snapshots still recording
-        # at tick start (HandleToken, node.go:174-185, vectorized)
+        # ---- token deliveries: credit via incidence matmul + record into
+        # snapshots still recording at tick start (HandleToken,
+        # node.go:174-185; 'all tokens before all markers' ordering)
         tok_e = deliver_e & ~popped_marker
-        amt_e = jnp.where(tok_e, popped_data, 0)
-        s = s._replace(tokens=s.tokens + jax.ops.segment_sum(
-            amt_e, self._edge_dst, num_segments=N))
+        amt_e = jnp.where(tok_e, popped_data, 0)                  # [E]
+        credit = (self._A_in @ amt_e.astype(f32)).astype(_i32)    # [N]
+        s = s._replace(tokens=s.tokens + credit)
         rec_mask = s.recording & tok_e[None, :]                   # [S, E]
         err = s.error | jnp.where(jnp.any(rec_mask & (s.rec_len >= M)),
                                   ERR_RECORD_OVERFLOW, 0).astype(_i32)
         pos = jnp.clip(s.rec_len, 0, M - 1)
-        # scatter-add one element per (snapshot, edge) — slots past rec_len
-        # are zero, so += lands the amount in the first free slot
+        hit_m = rec_mask[:, :, None] & (
+            jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
         s = s._replace(
-            rec_data=s.rec_data.at[
-                jnp.arange(S)[:, None], rows[None, :], pos].add(
-                jnp.where(rec_mask, amt_e[None, :], 0)),
+            rec_data=jnp.where(hit_m, amt_e[None, :, None], s.rec_data),
             rec_len=s.rec_len + rec_mask.astype(_i32),
             error=err,
         )
 
-        # marker deliveries, grouped by snapshot id (HandleMarker,
-        # node.go:149-171, vectorized over edges per slot)
-        any_marker = jnp.any(deliver_e & popped_marker)
+        # ---- marker deliveries, all snapshot slots at once (HandleMarker,
+        # node.go:149-171): arrivals per (slot, node) via incidence matmul;
+        # with k simultaneous markers for one (slot, node) all k channels are
+        # excluded from recording (CreateLocalSnapshot, node.go:58-84)
+        mk_e = deliver_e & popped_marker                          # [E]
+        mk_se = mk_e[None, :] & (
+            popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, E]
+        arrivals = (mk_se.astype(f32) @ self._A_in.T).astype(_i32)  # [S, N]
+        had = s.has_local                                          # [S, N]
+        created = (arrivals > 0) & ~had
+        created_f = created.astype(f32)
+        created_dst_se = (created_f @ self._A_in) > 0.5            # [S, E]
+        recording = (s.recording | created_dst_se) & ~mk_se
+        rem = jnp.where(created, self._in_degree[None, :] - arrivals,
+                        s.rem - jnp.where(had, arrivals, 0))
+        has_local = had | created
+        s = s._replace(
+            recording=recording,
+            frozen=jnp.where(created, s.tokens[None, :], s.frozen),
+            rem=rem,
+            has_local=has_local,
+        )
 
-        def per_sid(sid, s):
-            mk_e = deliver_e & popped_marker & (popped_data == sid)   # [E]
-            arrivals = jax.ops.segment_sum(mk_e.astype(_i32),
-                                           self._edge_dst, num_segments=N)
-            had = s.has_local[sid]                                    # [N]
-            created = (arrivals > 0) & ~had
-            # stop recording marker channels; created nodes record all other
-            # inbound channels (CreateLocalSnapshot, node.go:58-84 — with k
-            # simultaneous markers the k arrival channels are all excluded)
-            rec_row = s.recording[sid] & ~mk_e
-            rec_row = rec_row | (created[self._edge_dst] & ~mk_e)
-            rem_row = jnp.where(
-                created, self._in_degree - arrivals,
-                s.rem[sid] - jnp.where(had, arrivals, 0))
-            has_row = had | created
-            s = s._replace(
-                recording=s.recording.at[sid].set(rec_row),
-                frozen=s.frozen.at[sid].set(
-                    jnp.where(created, s.tokens, s.frozen[sid])),
-                rem=s.rem.at[sid].set(rem_row),
-                has_local=s.has_local.at[sid].set(has_row),
-            )
-            # re-broadcast from every node that just created its local
-            # snapshot (node.StartSnapshot, node.go:198-212)
-            s = lax.cond(
-                jnp.any(created),
-                lambda s: self._bulk_push(s, created[self._edge_src], True, sid),
-                lambda s: s, s)
-            # finalize (node.go:165-170)
-            fire = has_row & (rem_row == 0) & ~s.done_local[sid]
-            return s._replace(
-                done_local=s.done_local.at[sid].set(s.done_local[sid] | fire),
-                completed=s.completed.at[sid].add(
-                    jnp.sum(fire, dtype=_i32)),
-            )
+        # ---- re-broadcast from every node that just created its local
+        # snapshot (node.StartSnapshot, node.go:198-212): one marker per
+        # (slot, outbound edge) in one dense multi-push
+        push_se = (created_f @ self._A_out) > 0.5                  # [S, E]
+        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                   push_se.shape)
+        s = self._dense_push_multi(s, push_se, payload)
 
-        return lax.cond(
-            any_marker,
-            lambda s: lax.fori_loop(0, S, per_sid, s),
-            lambda s: s, s)
+        # ---- finalize (node.go:165-170)
+        fire = has_local & (rem == 0) & ~s.done_local
+        return s._replace(
+            done_local=s.done_local | fire,
+            completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32),
+        )
 
     def _run_ticks(self, s: DenseState, n) -> DenseState:
         """n is a traced i32 so every distinct ``tick N`` count shares one
@@ -355,24 +359,24 @@ class TickKernel:
     def _bulk_push(self, s: DenseState, active, is_marker: bool, data
                    ) -> DenseState:
         """Vectorized enqueue: one message on every edge where ``active``,
-        in a single scatter. Fast-path-only semantics: receive times are
-        drawn for every edge in one vectorized draw (inactive edges' draws
-        are discarded), so the stream does NOT match sequential per-event
-        sends under the Go-exact sampler — use _push/_inject_send for
-        bit-exact runs."""
+        written scatter-free via a one-hot select over the capacity axis
+        (dynamic-index scatters serialize badly on TPU; a dense [E, C] mask
+        is pure VPU work). Fast-path-only semantics: receive times are drawn
+        for every edge in one vectorized draw (inactive edges' draws are
+        discarded), so the stream does NOT match sequential per-event sends
+        under the Go-exact sampler — use _push/_inject_send for bit-exact
+        runs."""
         C = self.cfg.queue_capacity
         rts, dstate = self.delay.draw_many(s.delay_state, s.time, self.topo.e)
         err = s.error | jnp.where(jnp.any(active & (s.q_len >= C)),
                                   ERR_QUEUE_OVERFLOW, 0).astype(_i32)
-        rows = self._rows_e
         pos = (s.q_head + s.q_len) % C
+        hit = active[:, None] & (jnp.arange(C, dtype=_i32)[None, :] == pos[:, None])
+        data = jnp.broadcast_to(jnp.asarray(data, _i32), active.shape)
         return s._replace(
-            q_marker=s.q_marker.at[rows, pos].set(
-                jnp.where(active, is_marker, s.q_marker[rows, pos])),
-            q_data=s.q_data.at[rows, pos].set(
-                jnp.where(active, jnp.asarray(data, _i32), s.q_data[rows, pos])),
-            q_rtime=s.q_rtime.at[rows, pos].set(
-                jnp.where(active, jnp.asarray(rts, _i32), s.q_rtime[rows, pos])),
+            q_marker=jnp.where(hit, is_marker, s.q_marker),
+            q_data=jnp.where(hit, data[:, None], s.q_data),
+            q_rtime=jnp.where(hit, jnp.asarray(rts, _i32)[:, None], s.q_rtime),
             q_len=s.q_len + active.astype(_i32),
             delay_state=dstate,
             error=err,
@@ -391,6 +395,78 @@ class TickKernel:
                                   ).astype(_i32)
         s = s._replace(tokens=tokens, error=err)
         return self._bulk_push(s, active, False, amounts)
+
+    def _dense_push_multi(self, s: DenseState, push_se, payload_se) -> DenseState:
+        """Enqueue one message per True (slot, edge) of push_se in a single
+        dense [S, E, C] select, stacking same-edge pushes at consecutive ring
+        positions (slot order). Scatter-free; one vectorized delay draw per
+        (slot, edge) with inactive draws discarded (fast-path semantics)."""
+        C = self.cfg.queue_capacity
+        S = self.cfg.max_snapshots
+        cc = jnp.arange(C, dtype=_i32)[None, :]
+        k_e = jnp.sum(push_se, axis=0, dtype=_i32)                 # [E]
+        off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se  # exclusive
+        tail = (s.q_head + s.q_len) % C
+        slot_se = (tail[None, :] + off_se) % C                     # [S, E]
+        rts_se, dstate = self.delay.draw_many(s.delay_state, s.time,
+                                              (S, self.topo.e))
+        hit_c = push_se[:, :, None] & (cc[None] == slot_se[:, :, None])
+        any_hit = jnp.any(hit_c, axis=0)                           # [E, C]
+        data_val = jnp.sum(jnp.where(hit_c, payload_se[:, :, None], 0),
+                           axis=0, dtype=_i32)
+        rt_val = jnp.sum(jnp.where(hit_c, rts_se[:, :, None], 0), axis=0,
+                         dtype=_i32)
+        err = s.error | jnp.where(jnp.any(s.q_len + k_e > C),
+                                  ERR_QUEUE_OVERFLOW, 0).astype(_i32)
+        return s._replace(
+            q_marker=jnp.where(any_hit, True, s.q_marker),
+            q_data=jnp.where(any_hit, data_val, s.q_data),
+            q_rtime=jnp.where(any_hit, rt_val, s.q_rtime),
+            q_len=s.q_len + k_e,
+            delay_state=dstate,
+            error=err,
+        )
+
+    def _create_and_broadcast(self, s: DenseState, created) -> DenseState:
+        """Dense CreateLocalSnapshot + marker broadcast for every True
+        (slot, node) of ``created`` [S, N] (node.go:58-84 + node.go:97-109):
+        freeze balances, record all inbound channels, push one marker per
+        outbound edge per created slot."""
+        f32 = jnp.float32
+        created_f = created.astype(f32)
+        created_dst_se = (created_f @ self._A_in) > 0.5            # [S, E]
+        s = s._replace(
+            recording=s.recording | created_dst_se,
+            frozen=jnp.where(created, s.tokens[None, :], s.frozen),
+            rem=jnp.where(created, self._in_degree[None, :], s.rem),
+            has_local=s.has_local | created,
+        )
+        push_se = (created_f @ self._A_out) > 0.5                  # [S, E]
+        payload = jnp.broadcast_to(
+            jnp.arange(self.cfg.max_snapshots, dtype=_i32)[:, None],
+            push_se.shape)
+        return self._dense_push_multi(s, push_se, payload)
+
+    def _bulk_snapshots(self, s: DenseState, init_mask) -> DenseState:
+        """Vectorized sim.StartSnapshot (sim.go:105-123) for every node in
+        ``init_mask`` [N] at once: ids allocated in node-index order from
+        next_sid; the initiator records ALL inbound links and broadcasts.
+        Fast-path twin of _inject_snapshot (which stays scalar for the
+        bit-exact scheduler)."""
+        S = self.cfg.max_snapshots
+        count = jnp.sum(init_mask, dtype=_i32)
+        rank = jnp.cumsum(init_mask, dtype=_i32) - 1               # [N]
+        sid_n = s.next_sid + rank
+        created = init_mask[None, :] & (
+            sid_n[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, N]
+        err = s.error | jnp.where(s.next_sid + count > S,
+                                  ERR_SNAPSHOT_OVERFLOW, 0).astype(_i32)
+        s = s._replace(
+            next_sid=s.next_sid + count,
+            started=s.started | jnp.any(created, axis=1),
+            error=err,
+        )
+        return self._create_and_broadcast(s, created)
 
     # ---- drain (test_common.go:124-137) ---------------------------------
 

@@ -187,13 +187,22 @@ class BatchedRunner:
         """One storm phase for one instance: bulk sends + scheduled snapshot
         initiations + one tick. This is the framework's 'forward step'."""
         s = self.kernel._bulk_send(s, amounts)
+        if self.scheduler == "sync":
+            # dense initiation (ids allocated in node-index order == the
+            # schedule builder's order); the scalar path below would run its
+            # scatter-heavy broadcast under vmap's select semantics every
+            # phase even when no snapshot fires
+            init_mask = jnp.any(
+                jnp.arange(self.topo.n, dtype=jnp.int32)[None, :]
+                == snaps[:, None], axis=0)
+            s = self.kernel._bulk_snapshots(s, init_mask)
+        else:
+            def body(j, s):
+                return lax.cond(snaps[j] >= 0,
+                                lambda s: self.kernel._inject_snapshot(s, snaps[j]),
+                                lambda s: s, s)
 
-        def body(j, s):
-            return lax.cond(snaps[j] >= 0,
-                            lambda s: self.kernel._inject_snapshot(s, snaps[j]),
-                            lambda s: s, s)
-
-        s = lax.fori_loop(0, snaps.shape[-1], body, s)
+            s = lax.fori_loop(0, snaps.shape[-1], body, s)
         return self._tick_fn(s)
 
     def _run_storm_phases(self, s: DenseState, program) -> DenseState:

@@ -1,0 +1,73 @@
+"""Jit-compatible observability: invariants + aggregate counters.
+
+The reference's only observability is the debug event log (logger.go) and the
+test-side token-conservation check (test_common.go:298-328). Structured
+per-event capture is incompatible with jit hot loops (SURVEY.md §5), so the
+array backends expose the TPU-friendly equivalents:
+
+  - ``in_flight_tokens`` / ``conservation_delta``: the conservation invariant
+    as pure array reductions, evaluable under jit every K ticks;
+  - ``progress_counters``: queue depths, snapshot lifecycle counts, error
+    bits — cheap reductions whose cross-device lowering is the collective
+    path when the batch axis is sharded.
+
+All functions take a DenseState with ANY batching (none, leading axis,
+trailing axis): reductions run over the structural axes only where needed and
+otherwise reduce everything.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import DenseState
+
+
+def _occupied(state: DenseState, cfg: SimConfig):
+    """bool mask of live ring-buffer slots: positions head..head+len-1
+    (dense modular-interval test, no gathers). Works unbatched ([E, C]
+    queues) and lead-batched ([B, E, C]) — the capacity axis is last."""
+    c = cfg.queue_capacity
+    cc = jnp.arange(c, dtype=jnp.int32)
+    return ((cc - state.q_head[..., None]) % c) < state.q_len[..., None]
+
+
+def in_flight_tokens(state: DenseState, cfg: SimConfig) -> jnp.ndarray:
+    """Total tokens inside channels (non-marker live slots), all instances."""
+    occ = _occupied(state, cfg)
+    return jnp.sum(jnp.where(occ & ~state.q_marker, state.q_data, 0))
+
+
+def total_tokens(state: DenseState, cfg: SimConfig) -> jnp.ndarray:
+    """Node balances + in-flight tokens — the conserved quantity
+    (test_common.go:298-328 counts both)."""
+    return jnp.sum(state.tokens) + in_flight_tokens(state, cfg)
+
+
+def conservation_delta(state: DenseState, cfg: SimConfig,
+                       expected_total: int) -> jnp.ndarray:
+    """0 iff conservation holds (expected_total = initial tokens summed over
+    however many instances the state carries)."""
+    return total_tokens(state, cfg) - expected_total
+
+
+def progress_counters(state: DenseState, cfg: SimConfig,
+                      num_nodes: int) -> Dict[str, jnp.ndarray]:
+    """Aggregate lifecycle counters; under a sharded batch axis these
+    reductions lower to XLA collectives."""
+    started = state.started
+    complete = started & (state.completed >= num_nodes)
+    return {
+        "time_total": jnp.sum(state.time),
+        "time_max": jnp.max(state.time),
+        "queued_messages": jnp.sum(state.q_len),
+        "snapshots_started": jnp.sum(started),
+        "snapshots_completed": jnp.sum(complete),
+        "snapshots_pending": jnp.sum(started & ~complete),
+        "nodes_finalized": jnp.sum(state.done_local),
+        "recorded_messages": jnp.sum(state.rec_len),
+        "error_bits": jnp.max(state.error),
+    }

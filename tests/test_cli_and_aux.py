@@ -1,0 +1,109 @@
+"""CLI, checkpoint/resume, and metrics-module tests."""
+
+import io
+import json
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.cli import main
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.models.workloads import ring_topology, storm_program
+from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.checkpoint import load_state, save_state
+from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+from chandy_lamport_tpu.utils.goldens import fixture_path
+from chandy_lamport_tpu.utils.metrics import (
+    conservation_delta,
+    progress_counters,
+    total_tokens,
+)
+
+
+def _capture(argv):
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        code = main(argv)
+    finally:
+        sys.stdout = old
+    return code, out.getvalue()
+
+
+def test_cli_run_round_trips_golden(tmp_path):
+    code, out = _capture(["run", fixture_path("2nodes.top"),
+                          fixture_path("2nodes-message.events")])
+    assert code == 0
+    # output parses back through the golden reader and matches the golden
+    p = tmp_path / "out.snap"
+    p.write_text(out)
+    got = read_snapshot_file(str(p))
+    want = read_snapshot_file(fixture_path("2nodes-message.snap"))
+    assert got.id == want.id
+    assert got.token_map == want.token_map
+    assert got.messages == want.messages
+
+
+def test_cli_test_parity_backend_passes():
+    code, out = _capture(["test", "--backend", "parity"])
+    assert code == 0
+    assert "7/7 passed" in out
+
+
+def test_cli_storm_reports_counters(tmp_path):
+    ckpt = str(tmp_path / "state.npz")
+    code, out = _capture(["storm", "--graph", "ring", "--nodes", "8",
+                          "--batch", "4", "--phases", "6", "--snapshots", "2",
+                          "--checkpoint", ckpt])
+    assert code == 0
+    counters = json.loads(out)
+    assert counters["error_bits"] == 0
+    assert counters["conservation_delta"] == 0
+    assert counters["snapshots_completed"] == 2 * 4  # per-lane count summed
+
+
+def test_checkpoint_round_trip(tmp_path):
+    spec = ring_topology(6, tokens=50)
+    runner = BatchedRunner(spec, SimConfig(), UniformJaxDelay(3), batch=2,
+                           scheduler="sync")
+    prog = storm_program(runner.topo, phases=5, amount=1)
+    final = runner.run_storm(runner.init_batch(), prog)
+    path = str(tmp_path / "ck.npz")
+    save_state(path, final, meta={"note": "test"})
+    restored, meta = load_state(path, runner.init_batch())
+    assert meta["note"] == "test"
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(final)),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_shape_mismatch_rejected(tmp_path):
+    spec = ring_topology(6, tokens=50)
+    runner = BatchedRunner(spec, SimConfig(), UniformJaxDelay(3), batch=2,
+                           scheduler="sync")
+    path = str(tmp_path / "ck.npz")
+    save_state(path, runner.init_batch())
+    other = BatchedRunner(ring_topology(7, tokens=50), SimConfig(),
+                          UniformJaxDelay(3), batch=2, scheduler="sync")
+    with pytest.raises(ValueError, match="mismatch"):
+        load_state(path, other.init_batch())
+
+
+def test_metrics_conservation_under_jit():
+    spec = ring_topology(8, tokens=100)
+    cfg = SimConfig()
+    runner = BatchedRunner(spec, cfg, UniformJaxDelay(9), batch=4,
+                           scheduler="sync")
+    prog = storm_program(runner.topo, phases=8, amount=2)
+    mid = runner.run_storm(runner.init_batch(), prog, drain=False)
+    expected = int(runner.topo.tokens0.sum()) * 4
+    # mid-run: tokens are in flight, conservation must still hold exactly
+    delta = jax.jit(lambda s: conservation_delta(s, cfg, expected))(mid)
+    assert int(delta) == 0
+    assert int(total_tokens(mid, cfg)) == expected
+    counters = progress_counters(mid, cfg, runner.topo.n)
+    assert int(counters["queued_messages"]) > 0  # genuinely mid-flight
