@@ -106,6 +106,13 @@ class BatchedRunner:
         self.config = config or SimConfig()
         self.delay = delay
         self.batch = batch
+        # flush length must cover the sampler's actual max delay
+        # (test_common.go:135-137 flushes maxDelay+1 ticks)
+        if self.delay.max_delay != self.config.max_delay:
+            import dataclasses
+
+            self.config = dataclasses.replace(
+                self.config, max_delay=self.delay.max_delay)
         self.kernel = TickKernel(self.topo, self.config, self.delay)
         if scheduler == "exact":
             self._tick_fn = self.kernel._tick
