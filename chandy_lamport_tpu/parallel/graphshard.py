@@ -1,0 +1,480 @@
+"""Graph-sharded execution: one giant simulation instance spread over a mesh.
+
+This is the framework's tensor-parallel analogue (SURVEY.md §2.5): where the
+instance axis (parallel/batch.py) scales the number of independent
+simulations, this module scales the SIZE of a single simulated system —
+node and edge state sharded over a ``graph`` mesh axis, cross-shard effects
+carried by XLA collectives over ICI (psum / all_gather), exactly the role the
+reference's in-process "network" would need a real communication backend for
+at scale.
+
+Partitioning invariants that make the sync scheduler shard-local:
+  - nodes are split into P contiguous index blocks (node i -> shard i // (N/P));
+  - every edge lives on its SOURCE node's shard, so "first eligible head per
+    source" (the per-tick delivery choice) and all queue state are local;
+  - per-(slot, node) snapshot state (frozen/rem/has/done) lives on the
+    node's shard; per-(slot, edge) recording state lives with the edge.
+
+Collectives per tick (all small, all over ICI):
+  - psum of per-node token credits [N] (cross-shard token deliveries);
+  - psum of per-(slot, node) marker arrivals [S, N];
+  - all_gather of created-this-tick [S, N_local] -> [S, N] so source shards
+    can update recording flags and enqueue re-broadcast markers for remote
+    creators;
+  - psum of per-slot finalization counts and the error bitmask.
+
+Per-shard topology constants ride in as sharded ARGUMENTS (stacked on the
+shard axis) rather than closure constants, so one shard_map body serves every
+shard. The scheduler semantics are exactly `_sync_tick`'s (ops/tick.py):
+differential tests require bit-identical results to the unsharded kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import (
+    ERR_QUEUE_OVERFLOW,
+    ERR_RECORD_OVERFLOW,
+    ERR_SNAPSHOT_OVERFLOW,
+    ERR_TICK_LIMIT,
+    ERR_TOKEN_UNDERFLOW,
+    DenseTopology,
+)
+from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+_i32 = jnp.int32
+_f32 = jnp.float32
+
+
+class ShardedTopology(NamedTuple):
+    """Per-shard topology constants, stacked on the leading shard axis."""
+
+    edge_src: Any    # i32 [P, Em]  global src node id, -1 pad
+    edge_dst: Any    # i32 [P, Em]  global dst node id, -1 pad
+    a_in: Any        # f32 [P, N, Em]  one-hot dst incidence (0 for pads)
+    a_src: Any       # f32 [P, N, Em]  one-hot src incidence (0 for pads)
+    l_prior: Any     # f32 [P, Em, Em] same-src strict predecessor
+    in_degree: Any   # i32 [N] (replicated)
+
+
+class ShardedState(NamedTuple):
+    """One giant instance, sharded on the leading axis of every leaf except
+    the replicated scalars."""
+
+    time: Any        # i32 [] (replicated)
+    tokens: Any      # i32 [P, Nl]
+    q_marker: Any    # bool [P, Em, C]
+    q_data: Any      # i32 [P, Em, C]
+    q_rtime: Any     # i32 [P, Em, C]
+    q_head: Any      # i32 [P, Em]
+    q_len: Any       # i32 [P, Em]
+    next_sid: Any    # i32 [] (replicated)
+    started: Any     # bool [S] (replicated)
+    has_local: Any   # bool [P, S, Nl]
+    frozen: Any      # i32 [P, S, Nl]
+    rem: Any         # i32 [P, S, Nl]
+    done_local: Any  # bool [P, S, Nl]
+    recording: Any   # bool [P, S, Em]
+    rec_len: Any     # i32 [P, S, Em]
+    rec_data: Any    # i32 [P, S, Em, M]
+    completed: Any   # i32 [S] (replicated)
+    delay_key: Any   # u32 [P, 2] per-shard counter-based key
+    error: Any       # i32 [] (replicated)
+
+
+def shard_topology(topo: DenseTopology, shards: int) -> Tuple[ShardedTopology, int]:
+    """Partition nodes into contiguous blocks and edges by source shard;
+    pad per-shard edge arrays to the max local count."""
+    n, e = topo.n, topo.e
+    if n % shards:
+        raise ValueError(f"nodes ({n}) must divide evenly into {shards} shards")
+    nl = n // shards
+    shard_of = topo.edge_src // nl
+    counts = np.bincount(shard_of, minlength=shards)
+    em = int(counts.max()) if e else 1
+    edge_src = np.full((shards, em), -1, np.int32)
+    edge_dst = np.full((shards, em), -1, np.int32)
+    fill = np.zeros(shards, np.int64)
+    for i in range(e):  # edge order preserved within shard (src,dst sorted)
+        p = shard_of[i]
+        edge_src[p, fill[p]] = topo.edge_src[i]
+        edge_dst[p, fill[p]] = topo.edge_dst[i]
+        fill[p] += 1
+    a_in = np.zeros((shards, n, em), np.float32)
+    a_src = np.zeros((shards, n, em), np.float32)
+    l_prior = np.zeros((shards, em, em), np.float32)
+    for p in range(shards):
+        for j in range(int(counts[p])):
+            a_in[p, edge_dst[p, j], j] = 1.0
+            a_src[p, edge_src[p, j], j] = 1.0
+        src_row = edge_src[p]
+        l_prior[p] = ((src_row[None, :] == src_row[:, None])
+                      & (src_row[:, None] >= 0)
+                      & (np.arange(em)[None, :] < np.arange(em)[:, None]))
+    return ShardedTopology(
+        edge_src=jnp.asarray(edge_src), edge_dst=jnp.asarray(edge_dst),
+        a_in=jnp.asarray(a_in), a_src=jnp.asarray(a_src),
+        l_prior=jnp.asarray(l_prior),
+        in_degree=jnp.asarray(topo.in_degree),
+    ), em
+
+
+class GraphShardedRunner:
+    """Storm-program execution for a single giant instance over a graph mesh.
+
+    Semantics are identical to BatchedRunner(scheduler='sync') with batch=1 —
+    verified bit-exactly by tests/test_graphshard.py — but every array is
+    sharded over the ``graph`` axis of the mesh and the tick communicates via
+    collectives instead of living on one device.
+    """
+
+    def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
+                 mesh: Mesh, axis: str = "graph", seed: int = 0,
+                 max_delay: int = 5, fixed_delay: Optional[int] = None):
+        """fixed_delay: constant delay instead of the per-shard uniform
+        stream — lets differential tests demand bit-equality with the
+        unsharded kernel (counter-based streams differ by construction)."""
+        self.topo = DenseTopology(topology)
+        self.config = config or SimConfig()
+        self.mesh = mesh
+        self.axis = axis
+        self.shards = mesh.shape[axis]
+        self.seed = seed
+        self.max_delay = fixed_delay if fixed_delay is not None else max_delay
+        self.fixed_delay = fixed_delay
+        if self.config.max_delay != self.max_delay:
+            self.config = dataclasses.replace(self.config,
+                                              max_delay=self.max_delay)
+        self.stopo, self.em = shard_topology(self.topo, self.shards)
+        self.nl = self.topo.n // self.shards
+
+        spec_sharded = P(axis)
+        spec_rep = P()
+        topo_specs = ShardedTopology(
+            edge_src=spec_sharded, edge_dst=spec_sharded, a_in=spec_sharded,
+            a_src=spec_sharded, l_prior=spec_sharded, in_degree=spec_rep)
+        state_specs = ShardedState(
+            time=spec_rep, tokens=spec_sharded, q_marker=spec_sharded,
+            q_data=spec_sharded, q_rtime=spec_sharded, q_head=spec_sharded,
+            q_len=spec_sharded, next_sid=spec_rep, started=spec_rep,
+            has_local=spec_sharded, frozen=spec_sharded, rem=spec_sharded,
+            done_local=spec_sharded, recording=spec_sharded,
+            rec_len=spec_sharded, rec_data=spec_sharded, completed=spec_rep,
+            delay_key=spec_sharded, error=spec_rep)
+        self._state_specs = state_specs
+
+        from functools import partial
+
+        smap = partial(jax.shard_map, mesh=mesh, check_vma=False)
+        self._run = jax.jit(smap(
+            self._run_storm_body,
+            # program = (amounts [T, P, Em] sharded on the shard axis,
+            #            snapshot schedule replicated)
+            in_specs=(state_specs, topo_specs, (P(None, axis), spec_rep)),
+            out_specs=state_specs))
+
+    # -- state construction ------------------------------------------------
+
+    def init_state(self) -> ShardedState:
+        cfg, topo = self.config, self.topo
+        p, em, nl = self.shards, self.em, self.nl
+        c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
+        tokens = topo.tokens0.reshape(p, nl).copy()
+        base = jax.random.PRNGKey(self.seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(p, dtype=jnp.uint32))
+        state = ShardedState(
+            time=np.int32(0),
+            tokens=tokens,
+            q_marker=np.zeros((p, em, c), np.bool_),
+            q_data=np.zeros((p, em, c), np.int32),
+            q_rtime=np.zeros((p, em, c), np.int32),
+            q_head=np.zeros((p, em), np.int32),
+            q_len=np.zeros((p, em), np.int32),
+            next_sid=np.int32(0),
+            started=np.zeros(s, np.bool_),
+            has_local=np.zeros((p, s, nl), np.bool_),
+            frozen=np.zeros((p, s, nl), np.int32),
+            rem=np.zeros((p, s, nl), np.int32),
+            done_local=np.zeros((p, s, nl), np.bool_),
+            recording=np.zeros((p, s, em), np.bool_),
+            rec_len=np.zeros((p, s, em), np.int32),
+            rec_data=np.zeros((p, s, em, m), np.int32),
+            completed=np.zeros(s, np.int32),
+            delay_key=keys,
+            error=np.int32(0),
+        )
+        return jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(jnp.asarray(x),
+                                         NamedSharding(self.mesh, sp)),
+            state, self._state_specs)
+
+    def shard_program(self, amounts: np.ndarray, snap: np.ndarray):
+        """Split a StormProgram's [T, E] amounts into per-shard [T, P, Em]
+        (sharded on axis 1); the snapshot schedule stays replicated."""
+        t = amounts.shape[0]
+        out = np.zeros((t, self.shards, self.em), np.int32)
+        shard_of = self.topo.edge_src // self.nl
+        fill = np.zeros(self.shards, np.int64)
+        for i in range(self.topo.e):
+            p = shard_of[i]
+            out[:, p, fill[p]] = amounts[:, i]
+            fill[p] += 1
+        amounts_s = jax.device_put(
+            jnp.asarray(out), NamedSharding(self.mesh, P(None, self.axis)))
+        snap_r = jax.device_put(jnp.asarray(snap),
+                                NamedSharding(self.mesh, P()))
+        return amounts_s, snap_r
+
+    # -- collective helpers ------------------------------------------------
+
+    def _my_slice(self, arr_n):
+        """Local [.., Nl] block of a replicated [.., N] array."""
+        idx = lax.axis_index(self.axis) * self.nl
+        return lax.dynamic_slice_in_dim(arr_n, idx, self.nl, axis=-1)
+
+    # -- kernel pieces (run inside shard_map; shapes are per-shard) --------
+
+    def _draw_many(self, key, time, shape):
+        if self.fixed_delay is not None:
+            return jnp.full(shape, time + self.fixed_delay, _i32), key
+        key, sub = jax.random.split(key)
+        d = jax.random.randint(sub, shape, 0, self.max_delay, dtype=_i32)
+        return time + 1 + d, key
+
+    def _dense_push_multi(self, s: ShardedState, st: ShardedTopology,
+                          push_se, payload_se) -> ShardedState:
+        """Local twin of TickKernel._dense_push_multi (same stacking rule)."""
+        C = self.config.queue_capacity
+        cc = jnp.arange(C, dtype=_i32)[None, :]
+        k_e = jnp.sum(push_se, axis=0, dtype=_i32)
+        off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se
+        tail = (s.q_head + s.q_len) % C
+        slot_se = (tail[None, :] + off_se) % C
+        rts_se, key = self._draw_many(s.delay_key, s.time, push_se.shape)
+        hit_c = push_se[:, :, None] & (cc[None] == slot_se[:, :, None])
+        any_hit = jnp.any(hit_c, axis=0)
+        data_val = jnp.sum(jnp.where(hit_c, payload_se[:, :, None], 0),
+                           axis=0, dtype=_i32)
+        rt_val = jnp.sum(jnp.where(hit_c, rts_se[:, :, None], 0), axis=0,
+                         dtype=_i32)
+        err_local = jnp.any(s.q_len + k_e > C)
+        return s._replace(
+            q_marker=jnp.where(any_hit, True, s.q_marker),
+            q_data=jnp.where(any_hit, data_val, s.q_data),
+            q_rtime=jnp.where(any_hit, rt_val, s.q_rtime),
+            q_len=s.q_len + k_e,
+            delay_key=key,
+            error=s.error | lax.pmax(
+                jnp.where(err_local, ERR_QUEUE_OVERFLOW, 0).astype(_i32),
+                self.axis),
+        )
+
+    def _create_and_broadcast(self, s: ShardedState, st: ShardedTopology,
+                              created_global) -> ShardedState:
+        """created_global [S, N] replicated: freeze/record/broadcast for
+        every created (slot, node); remote creators reach this shard's
+        recording flags + queues through the replicated created matrix."""
+        S = self.config.max_snapshots
+        created_f = created_global.astype(_f32)
+        created_dst_se = (created_f @ st.a_in) > 0.5        # [S, Em] local
+        created_l = self._my_slice(created_global)           # [S, Nl]
+        s = s._replace(
+            recording=s.recording | created_dst_se,
+            frozen=jnp.where(created_l, s.tokens[None, :], s.frozen),
+            rem=jnp.where(created_l,
+                          self._my_slice(st.in_degree[None, :]), s.rem),
+            has_local=s.has_local | created_l,
+        )
+        push_se = (created_f @ st.a_src) > 0.5               # [S, Em] local
+        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                   push_se.shape)
+        return self._dense_push_multi(s, st, push_se, payload)
+
+    def _bulk_send(self, s: ShardedState, st: ShardedTopology,
+                   amounts) -> ShardedState:
+        """amounts [Em] local (sends originate on this shard's sources)."""
+        amounts = jnp.asarray(amounts, _i32)
+        active = amounts > 0
+        debits_n = st.a_src @ amounts.astype(_f32)           # [N], zero off-shard
+        tokens = s.tokens - self._my_slice(debits_n[None, :])[0].astype(_i32)
+        err_local = (jnp.any(tokens < 0).astype(_i32) * ERR_TOKEN_UNDERFLOW
+                     | (jnp.any(active & (s.q_len >= self.config.queue_capacity))
+                        .astype(_i32) * ERR_QUEUE_OVERFLOW))
+        err = lax.pmax(err_local, self.axis).astype(_i32)
+        s = s._replace(tokens=tokens, error=s.error | err)
+        rts, key = self._draw_many(s.delay_key, s.time, active.shape)
+        C = self.config.queue_capacity
+        cc = jnp.arange(C, dtype=_i32)[None, :]
+        pos = (s.q_head + s.q_len) % C
+        hit = active[:, None] & (cc == pos[:, None])
+        return s._replace(
+            q_marker=jnp.where(hit, False, s.q_marker),
+            q_data=jnp.where(hit, amounts[:, None], s.q_data),
+            q_rtime=jnp.where(hit, rts[:, None], s.q_rtime),
+            q_len=s.q_len + active.astype(_i32),
+            delay_key=key,
+        )
+
+    def _bulk_snapshots(self, s: ShardedState, st: ShardedTopology,
+                        init_mask_n) -> ShardedState:
+        """init_mask_n [N] replicated; ids in node-index order (the
+        _bulk_snapshots contract, ops/tick.py)."""
+        S = self.config.max_snapshots
+        count = jnp.sum(init_mask_n, dtype=_i32)
+        rank = jnp.cumsum(init_mask_n, dtype=_i32) - 1
+        sid_n = s.next_sid + rank
+        created = init_mask_n[None, :] & (
+            sid_n[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, N]
+        err = jnp.where(s.next_sid + count > S, ERR_SNAPSHOT_OVERFLOW, 0)
+        s = s._replace(next_sid=s.next_sid + count,
+                       started=s.started | jnp.any(created, axis=1),
+                       error=s.error | err.astype(_i32))
+        return self._create_and_broadcast(s, st, created)
+
+    def _sync_tick(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
+        """The sync scheduler with the cross-shard steps as collectives."""
+        cfg = self.config
+        C, S, M = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
+        time = s.time + 1
+        s = s._replace(time=time)
+        cc = jnp.arange(C, dtype=_i32)[None, :]
+
+        head_hit = cc == s.q_head[:, None]
+        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
+        popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
+                              dtype=_i32)
+        popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
+        elig = (s.q_len > 0) & (head_rt <= time)
+        prior = st.l_prior @ elig.astype(_f32)
+        deliver = elig & (prior < 0.5)
+        s = s._replace(q_head=(s.q_head + deliver) % C,
+                       q_len=s.q_len - deliver.astype(_i32))
+
+        # tokens: cross-shard credit via psum of per-node partials
+        tok = deliver & ~popped_marker
+        amt = jnp.where(tok, popped_data, 0)
+        credit_n = lax.psum(st.a_in @ amt.astype(_f32), self.axis)  # [N]
+        s = s._replace(tokens=s.tokens
+                       + self._my_slice(credit_n[None, :])[0].astype(_i32))
+        rec_mask = s.recording & tok[None, :]
+        err_local = jnp.any(rec_mask & (s.rec_len >= M)).astype(_i32)
+        pos = jnp.clip(s.rec_len, 0, M - 1)
+        hit_m = rec_mask[:, :, None] & (
+            jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
+        s = s._replace(
+            rec_data=jnp.where(hit_m, amt[None, :, None], s.rec_data),
+            rec_len=s.rec_len + rec_mask.astype(_i32),
+            error=s.error | lax.pmax(
+                (err_local * ERR_RECORD_OVERFLOW).astype(_i32), self.axis),
+        )
+
+        # markers: arrivals via psum, creations via all_gather
+        mk = deliver & popped_marker
+        mk_se = mk[None, :] & (
+            popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])
+        arrivals_n = lax.psum(mk_se.astype(_f32) @ st.a_in.T,
+                              self.axis).astype(_i32)          # [S, N]
+        arrivals_l = self._my_slice(arrivals_n)                # [S, Nl]
+        had_l = s.has_local
+        created_l = (arrivals_l > 0) & ~had_l
+        created_n = lax.all_gather(created_l, self.axis, axis=1,
+                                   tiled=True)                 # [S, N]
+        created_f = created_n.astype(_f32)
+        created_dst_se = (created_f @ st.a_in) > 0.5
+        s = s._replace(
+            recording=(s.recording | created_dst_se) & ~mk_se,
+            frozen=jnp.where(created_l, s.tokens[None, :], s.frozen),
+            rem=jnp.where(created_l,
+                          self._my_slice(st.in_degree[None, :]) - arrivals_l,
+                          s.rem - jnp.where(had_l, arrivals_l, 0)),
+            has_local=had_l | created_l,
+        )
+        push_se = (created_f @ st.a_src) > 0.5
+        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                   push_se.shape)
+        s = self._dense_push_multi(s, st, push_se, payload)
+
+        fire = s.has_local & (s.rem == 0) & ~s.done_local
+        fired = lax.psum(jnp.sum(fire, axis=-1, dtype=_i32), self.axis)  # [S]
+        return s._replace(done_local=s.done_local | fire,
+                          completed=s.completed + fired)
+
+    # -- program execution -------------------------------------------------
+
+    def _pending(self, s: ShardedState):
+        return jnp.any(s.started & (s.completed < self.topo.n))
+
+    def _unwrap(self, tree, specs):
+        """Inside shard_map the sharded leading axis arrives as a singleton;
+        strip it so the kernel sees per-shard logical shapes."""
+        sharded = P(self.axis)
+        return jax.tree_util.tree_map(
+            lambda x, sp: x[0] if sp == sharded else x, tree, specs,
+            is_leaf=lambda x: x is None)
+
+    def _wrap(self, tree, specs):
+        sharded = P(self.axis)
+        return jax.tree_util.tree_map(
+            lambda x, sp: x[None] if sp == sharded else x, tree, specs,
+            is_leaf=lambda x: x is None)
+
+    def _run_storm_body(self, s: ShardedState, st: ShardedTopology,
+                        program) -> ShardedState:
+        wrap_specs = self._state_specs
+        s = self._unwrap(s, wrap_specs)
+        st = self._unwrap(st, ShardedTopology(
+            edge_src=P(self.axis), edge_dst=P(self.axis), a_in=P(self.axis),
+            a_src=P(self.axis), l_prior=P(self.axis), in_degree=P()))
+        amounts, snap = program  # [T, 1, Em] shard slice, [T, J] replicated
+        amounts = amounts[:, 0, :]
+        program = (amounts, snap)
+
+        def phase(s, xs):
+            amts, snaps = xs
+            s = self._bulk_send(s, st, amts)
+            init_mask = jnp.any(
+                jnp.arange(self.topo.n, dtype=_i32)[None, :]
+                == snaps[:, None], axis=0)
+            s = self._bulk_snapshots(s, st, init_mask)
+            return self._sync_tick(s, st), None
+
+        s, _ = lax.scan(phase, s, (amounts, snap))
+        limit = jnp.asarray(s.time + self.config.max_ticks, _i32)
+        s = lax.while_loop(
+            lambda s: self._pending(s) & (s.time < limit),
+            lambda s: self._sync_tick(s, st), s)
+        s = s._replace(error=s.error | jnp.where(
+            self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
+        s = lax.fori_loop(0, self.config.max_delay + 1,
+                          lambda _, s: self._sync_tick(s, st), s)
+        return self._wrap(s, wrap_specs)
+
+    def run_storm(self, state: ShardedState, amounts: np.ndarray,
+                  snap: np.ndarray) -> ShardedState:
+        """amounts [T, E] (global edge order), snap [T, J]: runs the full
+        program + drain + flush SPMD over the graph mesh."""
+        amounts_s, snap_r = self.shard_program(np.asarray(amounts),
+                                               np.asarray(snap))
+        return self._run(state, self.stopo_device(), (amounts_s, snap_r))
+
+    def stopo_device(self) -> ShardedTopology:
+        if not hasattr(self, "_stopo_dev"):
+            specs = ShardedTopology(
+                edge_src=P(self.axis), edge_dst=P(self.axis),
+                a_in=P(self.axis), a_src=P(self.axis), l_prior=P(self.axis),
+                in_degree=P())
+            self._stopo_dev = jax.tree_util.tree_map(
+                lambda x, sp: jax.device_put(x, NamedSharding(self.mesh, sp)),
+                self.stopo, specs)
+        return self._stopo_dev
