@@ -11,12 +11,12 @@ batched with ``vmap`` over independent simulation instances and sharded with
 Layers (mirroring reference layers L0-L4, SURVEY.md §1):
   - ``core.spec``      message/snapshot/event types (reference common.go)
   - ``core.parity``    pure-Python oracle, bit-exact vs the Go reference
-  - ``core.topology``  string-id graphs -> dense CSR edge encoding
-  - ``core.dense``     dense array state for the JAX backend
-  - ``ops``            gorand PRNG, ring buffers, the jitted tick kernel
-  - ``models``         graph generators, delay models, the flagship batched sim
-  - ``parallel``       mesh/sharding: instance-parallel + node-sharded modes
-  - ``utils``          fixture parsers, golden comparison, tracing
+  - ``core.state``     string-id graphs -> dense edge encoding + array state
+  - ``core.dense``     single-instance JAX backend over that state
+  - ``ops``            gorand PRNG, ring buffers, the jitted tick kernels
+  - ``models``         graph generators, delay models, storm workloads
+  - ``parallel``       mesh/sharding: instance-parallel + graph-sharded modes
+  - ``utils``          fixture parsers, golden comparison, tracing, checkpoint
 """
 
 from chandy_lamport_tpu.config import SimConfig, MAX_DELAY

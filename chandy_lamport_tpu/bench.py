@@ -1,0 +1,268 @@
+"""Benchmark harness — north-star metric with hardened backend handling.
+
+Metric (BASELINE.md / BASELINE.json): node-ticks/sec/chip on the 1k-node
+scale-free graph with multiple concurrent snapshot initiators per instance
+(config 4 of the ladder). node-ticks = Σ over instances of N × ticks
+executed; throughput comes from the vmap instance axis while each tick
+preserves deterministic scheduler semantics (reference hot loop:
+/root/reference/chandy_lamport/sim.go:71-95).
+
+The reference publishes no performance numbers (BASELINE.md), so
+``vs_baseline`` is reported against the BASELINE.json north-star target of
+10M node-ticks/sec/chip (value 1.0 == target met).
+
+Structure (the round-1 bench died when the TPU plugin failed to init —
+one un-guarded ``jax.devices()`` zeroed the whole perf axis; this is the
+fix):
+
+* ``main()`` — orchestrator. Never imports jax. Runs the measurement in a
+  subprocess and, when the backend fails to initialize or the attempt hangs,
+  retries with ``JAX_PLATFORMS=''`` (auto-choice) and finally
+  ``JAX_PLATFORMS=cpu`` with a reduced workload. ALWAYS prints exactly one
+  JSON line on stdout and exits 0. The JSON carries ``platform`` /
+  ``device_kind`` so a CPU fallback can never masquerade as a TPU number.
+* ``worker`` mode (``--worker``) — the actual measurement; exit 3 means
+  "backend init failed, retry me elsewhere", any other nonzero exit is a
+  real failure (not retried on another platform).
+
+All diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+EXIT_BACKEND_INIT = 3  # worker: backend unavailable -> orchestrator retries
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="bench")
+    p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--attach", type=int, default=2, help="scale-free out-arcs per node")
+    p.add_argument("--batch", type=int, default=2048, help="vmap'd instances")
+    p.add_argument("--phases", type=int, default=32, help="storm phases (ticks with traffic)")
+    p.add_argument("--snapshots", type=int, default=8, help="concurrent initiators per instance")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--scheduler", choices=["sync", "exact"], default="sync",
+                   help="sync = vectorized simultaneous delivery (production "
+                        "path); exact = reference-semantics sequential fold")
+    p.add_argument("--target", type=float, default=10e6,
+                   help="north-star node-ticks/sec/chip (BASELINE.json)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture a jax.profiler trace of one timed run into DIR")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="orchestrator: per-attempt wall-clock limit (s)")
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# worker: the actual measurement (runs in a subprocess under the orchestrator)
+# ---------------------------------------------------------------------------
+
+def _memory_stats(dev) -> dict:
+    try:
+        stats = dev.memory_stats() or {}
+        return {"hbm_peak_bytes": int(stats.get("peak_bytes_in_use", 0)),
+                "hbm_limit_bytes": int(stats.get("bytes_limit", 0))}
+    except Exception:
+        return {}
+
+
+def run_worker(args) -> int:
+    import jax
+
+    # The env var JAX_PLATFORMS is not enough here: this image's TPU plugin
+    # (axon) programmatically sets jax_platforms at import time, overriding
+    # the environment. The orchestrator passes its platform choice via
+    # CLSIM_PLATFORM and the worker forces it through jax.config, which
+    # always wins.
+    platform = os.environ.get("CLSIM_PLATFORM")
+    if platform == "auto":
+        jax.config.update("jax_platforms", "")  # jax picks best available
+    elif platform:
+        jax.config.update("jax_platforms", platform)
+    try:
+        dev = jax.devices()[0]
+    except Exception as exc:  # backend init is exactly the retryable failure
+        log(f"backend init failed: {type(exc).__name__}: {exc}")
+        return EXIT_BACKEND_INIT
+
+    import numpy as np
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import (
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+
+    log(f"device: {dev.platform} ({dev.device_kind}); "
+        f"N={args.nodes} B={args.batch} phases={args.phases} "
+        f"scheduler={args.scheduler}")
+
+    spec = scale_free(args.nodes, args.attach, seed=3, tokens=args.phases + 10)
+    cfg = SimConfig(queue_capacity=16, max_snapshots=max(8, args.snapshots),
+                    max_recorded=16)
+    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
+                           batch=args.batch, scheduler=args.scheduler)
+    topo = runner.topo
+    log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree {topo.d}")
+    from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
+
+    per = instance_footprint_bytes(topo.n, topo.e, cfg)
+    log(f"per-instance state: {per / 1e6:.3f} MB; "
+        f"batch resident {per * args.batch / 1e9:.2f} GB")
+    prog = storm_program(
+        topo, phases=args.phases, amount=1,
+        snapshot_phases=staggered_snapshots(topo, args.snapshots, 1, 2,
+                                            max_phases=args.phases))
+
+    # warmup: compile + one full execution
+    t0 = time.perf_counter()
+    final = runner.run_storm(runner.init_batch(), prog)
+    jax.block_until_ready(final)
+    log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
+    summary = BatchedRunner.summarize(final)
+    log(f"summary: {summary}")
+    if summary["error_lanes"]:
+        log("ERROR: lanes with error flags — results invalid")
+        return 1
+    if summary["snapshots_completed"] != summary["snapshots_started"]:
+        log("ERROR: incomplete snapshots")
+        return 1
+
+    times, node_ticks = [], []
+    for r in range(args.repeats):
+        state = runner.init_batch()
+        jax.block_until_ready(state)
+        profiling = args.profile and r == args.repeats - 1
+        if profiling:
+            jax.profiler.start_trace(args.profile)
+        t0 = time.perf_counter()
+        final = runner.run_storm(state, prog)
+        jax.block_until_ready(final)
+        dt = time.perf_counter() - t0
+        if profiling:
+            jax.profiler.stop_trace()
+            log(f"profile trace written to {args.profile}")
+        total_ticks = int(np.asarray(jax.device_get(final.time)).sum())
+        times.append(dt)
+        node_ticks.append(total_ticks * topo.n)
+        ticks_per_lane = total_ticks / args.batch
+        log(f"run {r}: {dt:.3f}s, {total_ticks} total ticks "
+            f"({ticks_per_lane:.1f}/lane, {dt / ticks_per_lane * 1e3:.2f}ms "
+            f"per batched tick) -> {node_ticks[-1] / dt / 1e6:.2f}M node-ticks/s")
+
+    best = max(nt / dt for nt, dt in zip(node_ticks, times))
+    result = {
+        "metric": "node_ticks_per_sec_per_chip",
+        "value": round(best, 1),
+        "unit": "node-ticks/s/chip",
+        "vs_baseline": round(best / args.target, 3),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "scheduler": args.scheduler,
+        "nodes": args.nodes,
+        "batch": args.batch,
+    }
+    result.update(_memory_stats(dev))
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestrator: subprocess attempts with platform fallback; exit 0 always
+# ---------------------------------------------------------------------------
+
+def _attempts(args):
+    """(name, env-overrides, extra-cli-args, timeout) per attempt, in order.
+
+    The TPU attempt is bounded by --timeout because the plugin has been
+    observed to HANG in jax.devices() (not just fail fast) when the device
+    tunnel is down; the orchestrator kills it and falls back."""
+    yield "default", {}, [], args.timeout
+    # retry at full size with jax's automatic platform choice — covers
+    # transient plugin-init failures ("set JAX_PLATFORMS='' to automatically
+    # choose an available backend", the round-1 failure mode)
+    yield "auto", {"CLSIM_PLATFORM": "auto"}, [], args.timeout
+    # last resort: CPU with a reduced workload so it finishes; the JSON line
+    # carries platform=cpu so this can never masquerade as a TPU number
+    cpu_args = ["--nodes", str(min(args.nodes, 256)),
+                "--batch", str(min(args.batch, 64)),
+                "--phases", str(min(args.phases, 16)),
+                "--repeats", "1"]
+    yield "cpu", {"CLSIM_PLATFORM": "cpu"}, cpu_args, min(args.timeout, 600.0)
+
+
+def _run_attempt(name, env_overrides, extra, timeout, argv):
+    env = dict(os.environ)
+    env.update(env_overrides)
+    # the child must find the package regardless of the parent's cwd (the
+    # repo-root wrapper's sys.path edit doesn't reach a subprocess)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "chandy_lamport_tpu.bench",
+           "--worker"] + argv + extra
+    log(f"--- attempt '{name}' (timeout {timeout:.0f}s): {' '.join(cmd)}")
+    try:
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log(f"attempt '{name}' timed out after {timeout:.0f}s")
+        return None, True
+    out = proc.stdout.decode(errors="replace").strip().splitlines()
+    if proc.returncode == 0 and out:
+        try:
+            parsed = json.loads(out[-1])
+            parsed["attempt"] = name
+            return parsed, False
+        except json.JSONDecodeError:
+            log(f"attempt '{name}': unparseable stdout {out[-1]!r}")
+            return None, False
+    retryable = proc.returncode in (EXIT_BACKEND_INIT, -6, -9, -11)
+    log(f"attempt '{name}' failed rc={proc.returncode} "
+        f"(retryable={retryable})")
+    return None, retryable
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    args = _parser().parse_args(argv)
+    if args.worker:
+        return run_worker(args)
+
+    argv = [a for a in argv if a != "--worker"]
+    for name, env_overrides, extra, timeout in _attempts(args):
+        parsed, retryable = _run_attempt(name, env_overrides, extra,
+                                         timeout, argv)
+        if parsed is not None:
+            print(json.dumps(parsed), flush=True)
+            return 0
+        if not retryable:
+            break
+    # every environment gets a parseable line and exit 0
+    print(json.dumps({
+        "metric": "node_ticks_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "node-ticks/s/chip",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": "all benchmark attempts failed (see stderr)",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,7 +67,7 @@ def _cmd_test(args) -> int:
             for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
                 assert_snapshots_equal(e, a)
             print(f"PASS {name}")
-        except (SnapshotMismatch, AssertionError, Exception) as exc:
+        except (SnapshotMismatch, AssertionError, ValueError, OSError) as exc:
             failures += 1
             print(f"FAIL {name}: {exc}")
     print(f"{len(REFERENCE_TESTS) - failures}/{len(REFERENCE_TESTS)} passed")
@@ -124,19 +124,20 @@ def _cmd_storm(args) -> int:
 
 
 def _cmd_bench(args) -> int:
-    import runpy
-    import os
+    from chandy_lamport_tpu.bench import main as bench_main
 
-    sys.argv = ["bench.py"] + args.bench_args
-    runpy.run_path(os.path.join(os.path.dirname(__file__), "..", "bench.py"),
-                   run_name="__main__")
-    return 0
+    return bench_main(args.bench_args)
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="chandy_lamport_tpu",
                                 description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--platform", default=None,
+                   help="force the JAX platform (e.g. cpu, tpu). This image's "
+                        "TPU plugin registers itself programmatically, so the "
+                        "JAX_PLATFORMS env var alone cannot override it; "
+                        "CLSIM_PLATFORM works too")
     sub = p.add_subparsers(dest="command", required=True)
 
     pr = sub.add_parser("run", help="run a .top + .events pair")
@@ -169,6 +170,18 @@ def main(argv=None) -> int:
     pb.set_defaults(fn=_cmd_bench)
 
     args = p.parse_args(argv)
+    import os
+
+    platform = args.platform or os.environ.get("CLSIM_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    if getattr(args, "backend", None) == "jax":
+        # the bit-exact Go-PRNG delay stream needs 64-bit integers under jit
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
     return args.fn(args)
 
 

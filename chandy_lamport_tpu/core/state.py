@@ -46,6 +46,13 @@ ERR_SNAPSHOT_OVERFLOW = 2
 ERR_RECORD_OVERFLOW = 4
 ERR_TOKEN_UNDERFLOW = 8
 ERR_TICK_LIMIT = 16
+ERR_VALUE_OVERFLOW = 32
+
+# largest token amount the sync scheduler's f32 incidence matmuls carry
+# exactly; amounts at or beyond this fire ERR_VALUE_OVERFLOW instead of
+# silently violating conservation (the exact scheduler is pure-integer and
+# unaffected)
+F32_EXACT_LIMIT = 1 << 24
 
 ERROR_NAMES = {
     ERR_QUEUE_OVERFLOW: "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)",
@@ -53,6 +60,8 @@ ERROR_NAMES = {
     ERR_RECORD_OVERFLOW: "recorded-message capacity exceeded (raise SimConfig.max_recorded)",
     ERR_TOKEN_UNDERFLOW: "node sent more tokens than it had (reference log.Fatal, node.go:113-116)",
     ERR_TICK_LIMIT: "drain loop hit max_ticks (graph not strongly connected?)",
+    ERR_VALUE_OVERFLOW: "token amount >= 2^24 on the sync scheduler (f32 "
+                        "reductions no longer exact; use scheduler='exact')",
 }
 
 

@@ -104,6 +104,10 @@ def storm_program(topo: DenseTopology, phases: int, amount: int = 1,
     sched = list(snapshot_phases or [])
     per_phase: List[List[int]] = [[] for _ in range(t)]
     for ph, node in sched:
+        if not 0 <= ph < t:
+            raise ValueError(
+                f"snapshot scheduled at phase {ph}, but the program has "
+                f"only {t} phases (raise phases or tighten the schedule)")
         per_phase[ph].append(node)
     j = max((len(p) for p in per_phase), default=0) or 1
     snap = np.full((t, j), -1, np.int32)
@@ -114,7 +118,20 @@ def storm_program(topo: DenseTopology, phases: int, amount: int = 1,
 
 def staggered_snapshots(topo: DenseTopology, count: int,
                         start_phase: int = 0, stride: int = 1,
+                        max_phases: Optional[int] = None,
                         ) -> List[Tuple[int, int]]:
     """The 10nodes.events pattern: snapshot k initiated by node k at phase
-    start + k*stride."""
-    return [(start_phase + k * stride, k % topo.n) for k in range(count)]
+    start + k*stride. With ``max_phases``, the stride shrinks (floor 1) and
+    the schedule wraps so every initiation fits a ``max_phases``-phase
+    program."""
+    if max_phases is not None:
+        if max_phases < 1:
+            raise ValueError("max_phases must be >= 1")
+        start_phase = min(start_phase, max_phases - 1)
+        if count > 1:
+            stride = max(min(stride, (max_phases - 1 - start_phase)
+                             // (count - 1)), 1)
+    sched = [(start_phase + k * stride, k % topo.n) for k in range(count)]
+    if max_phases is not None:
+        sched = [(ph % max_phases, node) for ph, node in sched]
+    return sched

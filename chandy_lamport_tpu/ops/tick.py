@@ -43,6 +43,8 @@ from chandy_lamport_tpu.core.state import (
     ERR_SNAPSHOT_OVERFLOW,
     ERR_TICK_LIMIT,
     ERR_TOKEN_UNDERFLOW,
+    ERR_VALUE_OVERFLOW,
+    F32_EXACT_LIMIT,
 )
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 
@@ -271,8 +273,14 @@ class TickKernel:
         # node.go:174-185; 'all tokens before all markers' ordering)
         tok_e = deliver_e & ~popped_marker
         amt_e = jnp.where(tok_e, popped_data, 0)                  # [E]
-        credit = (self._A_in @ amt_e.astype(f32)).astype(_i32)    # [N]
-        s = s._replace(tokens=s.tokens + credit)
+        credit_f = self._A_in @ amt_e.astype(f32)                 # [N]
+        # f32 incidence reductions are exact only below 2^24; flag instead of
+        # silently violating conservation (the exact scheduler is integer)
+        inexact = (jnp.any(amt_e >= F32_EXACT_LIMIT)
+                   | jnp.any(credit_f >= F32_EXACT_LIMIT))
+        s = s._replace(
+            tokens=s.tokens + credit_f.astype(_i32),
+            error=s.error | jnp.where(inexact, ERR_VALUE_OVERFLOW, 0).astype(_i32))
         rec_mask = s.recording & tok_e[None, :]                   # [S, E]
         err = s.error | jnp.where(jnp.any(rec_mask & (s.rec_len >= M)),
                                   ERR_RECORD_OVERFLOW, 0).astype(_i32)

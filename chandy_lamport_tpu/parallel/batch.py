@@ -42,11 +42,13 @@ OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
 
 
 class ScriptOps(NamedTuple):
-    """A compiled event script: T phases of up to K ops, one tick per phase."""
+    """A compiled event script: T phases of up to K ops, each phase followed
+    by one tick iff its ``do_tick`` entry is set."""
 
-    kind: Any   # i32 [T, K]
-    arg0: Any   # i32 [T, K]  edge index (send) | node index (snapshot)
-    arg1: Any   # i32 [T, K]  token amount (send)
+    kind: Any      # i32 [T, K]
+    arg0: Any      # i32 [T, K]  edge index (send) | node index (snapshot)
+    arg1: Any      # i32 [T, K]  token amount (send)
+    do_tick: Any   # i32 [T]     0 only for a synthetic trailing phase
 
     @property
     def num_phases(self) -> int:
@@ -55,8 +57,10 @@ class ScriptOps(NamedTuple):
 
 def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
     """Events -> dense op tensors. Each ``tick n`` closes the current phase
-    and appends n-1 empty phases; trailing non-tick events get a final phase
-    (its tick is outcome-equivalent to the first drain tick, SURVEY.md §3.5)."""
+    and appends n-1 empty phases; trailing non-tick events get a final
+    synthetic phase with ``do_tick=0``, so no-drain runs stop exactly where
+    the single-instance backend does (injected but unticked) while drained
+    runs are unaffected (the drain loop supplies the tick, SURVEY.md §3.5)."""
     phases: List[List[tuple]] = []
     cur: List[tuple] = []
     for ev in events:
@@ -75,6 +79,7 @@ def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
                 phases.append([])
         else:
             raise TypeError(f"unknown event: {ev!r}")
+    synthetic_final = bool(cur)
     if cur:
         phases.append(cur)
     t = max(len(phases), 1)
@@ -82,10 +87,13 @@ def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
     kind = np.zeros((t, k), np.int32)
     arg0 = np.zeros((t, k), np.int32)
     arg1 = np.zeros((t, k), np.int32)
+    do_tick = np.ones(t, np.int32)
+    if synthetic_final:
+        do_tick[-1] = 0
     for i, ops in enumerate(phases):
         for j, (op, a0, a1) in enumerate(ops):
             kind[i, j], arg0[i, j], arg1[i, j] = op, a0, a1
-    return ScriptOps(kind, arg0, arg1)
+    return ScriptOps(kind, arg0, arg1, do_tick)
 
 
 class BatchedRunner:
@@ -158,7 +166,7 @@ class BatchedRunner:
     # -- execution ---------------------------------------------------------
 
     def _apply_phase(self, s: DenseState, ops) -> DenseState:
-        kind, arg0, arg1 = ops
+        kind, arg0, arg1, do_tick = ops
 
         def body(j, s):
             return lax.switch(kind[j], [
@@ -168,7 +176,7 @@ class BatchedRunner:
             ], s)
 
         s = lax.fori_loop(0, kind.shape[0], body, s)
-        return self._tick_fn(s)
+        return lax.cond(do_tick != 0, self._tick_fn, lambda s: s, s)
 
     def _run_single_no_drain(self, s: DenseState, script: ScriptOps) -> DenseState:
         def phase(s, ops):

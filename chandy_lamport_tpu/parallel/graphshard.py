@@ -47,6 +47,8 @@ from chandy_lamport_tpu.core.state import (
     ERR_SNAPSHOT_OVERFLOW,
     ERR_TICK_LIMIT,
     ERR_TOKEN_UNDERFLOW,
+    ERR_VALUE_OVERFLOW,
+    F32_EXACT_LIMIT,
     DenseTopology,
 )
 from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
@@ -65,6 +67,24 @@ class ShardedTopology(NamedTuple):
     a_src: Any       # f32 [P, N, Em]  one-hot src incidence (0 for pads)
     l_prior: Any     # f32 [P, Em, Em] same-src strict predecessor
     in_degree: Any   # i32 [N] (replicated)
+
+
+class ShardedScript(NamedTuple):
+    """An event script compiled for sharded execution — all leaves
+    REPLICATED (each shard masks send ops by owning shard):
+      kind  i32 [T, K]  0=nop, 1=send, 2=snapshot
+      shard i32 [T, K]  owning shard of a send's edge, -1 otherwise
+      loc   i32 [T, K]  send: local edge index on the owning shard;
+                        snapshot: global node index
+      arg   i32 [T, K]  send: token amount
+      do_tick i32 [T]   0 only for a synthetic trailing phase
+    """
+
+    kind: Any
+    shard: Any
+    loc: Any
+    arg: Any
+    do_tick: Any
 
 
 class ShardedState(NamedTuple):
@@ -158,6 +178,16 @@ class GraphShardedRunner:
         self.stopo, self.em = shard_topology(self.topo, self.shards)
         self.nl = self.topo.n // self.shards
 
+        # global edge -> (owning shard, local slot) in shard fill order;
+        # used by shard_program and the event-script compiler
+        shard_of = self.topo.edge_src // self.nl
+        self.edge_shard = shard_of.astype(np.int32)
+        self.edge_local = np.zeros(self.topo.e, np.int32)
+        fill = np.zeros(self.shards, np.int64)
+        for i in range(self.topo.e):
+            self.edge_local[i] = fill[shard_of[i]]
+            fill[shard_of[i]] += 1
+
         spec_sharded = P(axis)
         spec_rep = P()
         topo_specs = ShardedTopology(
@@ -176,12 +206,19 @@ class GraphShardedRunner:
         from functools import partial
 
         smap = partial(jax.shard_map, mesh=mesh, check_vma=False)
+        self._topo_specs = topo_specs
         self._run = jax.jit(smap(
             self._run_storm_body,
             # program = (amounts [T, P, Em] sharded on the shard axis,
             #            snapshot schedule replicated)
             in_specs=(state_specs, topo_specs, (P(None, axis), spec_rep)),
             out_specs=state_specs))
+        script_specs = ShardedScript(*(spec_rep,) * 5)
+        self._run_script = jax.jit(smap(
+            self._run_script_body,
+            in_specs=(state_specs, topo_specs, script_specs),
+            out_specs=state_specs))
+        self._run_batched_cache = {}
 
     # -- state construction ------------------------------------------------
 
@@ -224,17 +261,39 @@ class GraphShardedRunner:
         (sharded on axis 1); the snapshot schedule stays replicated."""
         t = amounts.shape[0]
         out = np.zeros((t, self.shards, self.em), np.int32)
-        shard_of = self.topo.edge_src // self.nl
-        fill = np.zeros(self.shards, np.int64)
-        for i in range(self.topo.e):
-            p = shard_of[i]
-            out[:, p, fill[p]] = amounts[:, i]
-            fill[p] += 1
+        out[:, self.edge_shard, self.edge_local] = amounts
         amounts_s = jax.device_put(
             jnp.asarray(out), NamedSharding(self.mesh, P(None, self.axis)))
         snap_r = jax.device_put(jnp.asarray(snap),
                                 NamedSharding(self.mesh, P()))
         return amounts_s, snap_r
+
+    def compile_script(self, events) -> ShardedScript:
+        """Compile an event script (the reference .events shape) for sharded
+        execution: reuse the dense compiler (parallel/batch.compile_events),
+        then remap each send's global edge index to (owning shard, local
+        slot). All leaves replicated — ops are masked per shard at run
+        time so every shard executes one identical collective schedule."""
+        from chandy_lamport_tpu.parallel.batch import (
+            OP_SEND,
+            OP_SNAPSHOT,
+            compile_events,
+        )
+
+        ops = compile_events(self.topo, events)
+        kind = np.asarray(ops.kind)
+        arg0 = np.asarray(ops.arg0)
+        arg1 = np.asarray(ops.arg1)
+        # arg0 holds NODE indices for snapshot ops — clip before the eager
+        # edge-table lookup (a node index can exceed the edge count)
+        e_safe = np.clip(arg0, 0, max(self.topo.e - 1, 0))
+        shard = np.where(kind == OP_SEND, self.edge_shard[e_safe], -1)
+        loc = np.where(kind == OP_SEND, self.edge_local[e_safe],
+                       np.where(kind == OP_SNAPSHOT, arg0, 0))
+        rep = NamedSharding(self.mesh, P())
+        return ShardedScript(
+            *(jax.device_put(jnp.asarray(x, jnp.int32), rep)
+              for x in (kind, shard, loc, arg1, np.asarray(ops.do_tick))))
 
     # -- collective helpers ------------------------------------------------
 
@@ -242,6 +301,18 @@ class GraphShardedRunner:
         """Local [.., Nl] block of a replicated [.., N] array."""
         idx = lax.axis_index(self.axis) * self.nl
         return lax.dynamic_slice_in_dim(arr_n, idx, self.nl, axis=-1)
+
+    def _por(self, mask):
+        """Bitwise-OR reduction of an error bitmask across shards. lax.pmax
+        is NOT a bitwise OR: with ERR_TOKEN_UNDERFLOW on one shard and
+        ERR_QUEUE_OVERFLOW on another in the same update, max would drop the
+        smaller bit and decode_errors would mislabel the cause. Per-bit
+        psum>0 preserves every flag."""
+        mask = jnp.asarray(mask, _i32)
+        shifts = jnp.arange(8, dtype=_i32)  # 6 ERR_ bits defined; headroom
+        bits = (mask[..., None] >> shifts) & 1
+        any_bit = lax.psum(bits, self.axis) > 0
+        return jnp.sum(any_bit.astype(_i32) << shifts, axis=-1, dtype=_i32)
 
     # -- kernel pieces (run inside shard_map; shapes are per-shard) --------
 
@@ -275,9 +346,8 @@ class GraphShardedRunner:
             q_rtime=jnp.where(any_hit, rt_val, s.q_rtime),
             q_len=s.q_len + k_e,
             delay_key=key,
-            error=s.error | lax.pmax(
-                jnp.where(err_local, ERR_QUEUE_OVERFLOW, 0).astype(_i32),
-                self.axis),
+            error=s.error | self._por(
+                jnp.where(err_local, ERR_QUEUE_OVERFLOW, 0)),
         )
 
     def _create_and_broadcast(self, s: ShardedState, st: ShardedTopology,
@@ -310,9 +380,11 @@ class GraphShardedRunner:
         tokens = s.tokens - self._my_slice(debits_n[None, :])[0].astype(_i32)
         err_local = (jnp.any(tokens < 0).astype(_i32) * ERR_TOKEN_UNDERFLOW
                      | (jnp.any(active & (s.q_len >= self.config.queue_capacity))
-                        .astype(_i32) * ERR_QUEUE_OVERFLOW))
-        err = lax.pmax(err_local, self.axis).astype(_i32)
-        s = s._replace(tokens=tokens, error=s.error | err)
+                        .astype(_i32) * ERR_QUEUE_OVERFLOW)
+                     | (jnp.any(amounts >= F32_EXACT_LIMIT)
+                        | jnp.any(debits_n >= F32_EXACT_LIMIT)
+                        ).astype(_i32) * ERR_VALUE_OVERFLOW)
+        s = s._replace(tokens=tokens, error=s.error | self._por(err_local))
         rts, key = self._draw_many(s.delay_key, s.time, active.shape)
         C = self.config.queue_capacity
         cc = jnp.arange(C, dtype=_i32)[None, :]
@@ -342,6 +414,41 @@ class GraphShardedRunner:
                        error=s.error | err.astype(_i32))
         return self._create_and_broadcast(s, st, created)
 
+    def _inject_send_local(self, s: ShardedState, st: ShardedTopology,
+                           eloc, amt, active) -> ShardedState:
+        """One script send op, masked: only the shard owning the edge debits
+        and enqueues; every shard runs the same code (and the same _por
+        collective) so the SPMD schedules stay aligned. Mirrors
+        TickKernel._inject_send semantics (debit at send time,
+        node.go:112-131)."""
+        C = self.config.queue_capacity
+        e = jnp.clip(eloc, 0, self.em - 1)
+        amt_i = jnp.asarray(amt, _i32)
+        base = lax.axis_index(self.axis) * self.nl
+        src_l = jnp.clip(st.edge_src[e] - base, 0, self.nl - 1)
+        a = jnp.asarray(active, _i32)
+        err_local = (
+            (active & (s.tokens[src_l] < amt_i)).astype(_i32) * ERR_TOKEN_UNDERFLOW
+            | (active & (s.q_len[e] >= C)).astype(_i32) * ERR_QUEUE_OVERFLOW
+            | (active & (amt_i >= F32_EXACT_LIMIT)).astype(_i32)
+            * ERR_VALUE_OVERFLOW)
+        rt, key = self._draw_many(s.delay_key, s.time, ())
+        pos = (s.q_head[e] + s.q_len[e]) % C
+
+        def sel(old, new):
+            return jnp.where(active, new, old)
+
+        return s._replace(
+            tokens=s.tokens.at[src_l].add(-amt_i * a),
+            q_marker=s.q_marker.at[e, pos].set(sel(s.q_marker[e, pos], False)),
+            q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i)),
+            q_rtime=s.q_rtime.at[e, pos].set(
+                sel(s.q_rtime[e, pos], jnp.asarray(rt, _i32))),
+            q_len=s.q_len.at[e].add(a),
+            delay_key=key,
+            error=s.error | self._por(err_local),
+        )
+
     def _sync_tick(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
         """The sync scheduler with the cross-shard steps as collectives."""
         cfg = self.config
@@ -365,8 +472,14 @@ class GraphShardedRunner:
         tok = deliver & ~popped_marker
         amt = jnp.where(tok, popped_data, 0)
         credit_n = lax.psum(st.a_in @ amt.astype(_f32), self.axis)  # [N]
-        s = s._replace(tokens=s.tokens
-                       + self._my_slice(credit_n[None, :])[0].astype(_i32))
+        # f32 reductions exact only below 2^24 (same guard as the unsharded
+        # sync tick); psum makes the threshold check see the global credit
+        inexact = (jnp.any(amt >= F32_EXACT_LIMIT)
+                   | jnp.any(credit_n >= F32_EXACT_LIMIT)).astype(_i32)
+        s = s._replace(
+            tokens=s.tokens
+            + self._my_slice(credit_n[None, :])[0].astype(_i32),
+            error=s.error | self._por(inexact * ERR_VALUE_OVERFLOW))
         rec_mask = s.recording & tok[None, :]
         err_local = jnp.any(rec_mask & (s.rec_len >= M)).astype(_i32)
         pos = jnp.clip(s.rec_len, 0, M - 1)
@@ -375,8 +488,7 @@ class GraphShardedRunner:
         s = s._replace(
             rec_data=jnp.where(hit_m, amt[None, :, None], s.rec_data),
             rec_len=s.rec_len + rec_mask.astype(_i32),
-            error=s.error | lax.pmax(
-                (err_local * ERR_RECORD_OVERFLOW).astype(_i32), self.axis),
+            error=s.error | self._por(err_local * ERR_RECORD_OVERFLOW),
         )
 
         # markers: arrivals via psum, creations via all_gather
@@ -441,24 +553,75 @@ class GraphShardedRunner:
         program = (amounts, snap)
 
         def phase(s, xs):
-            amts, snaps = xs
-            s = self._bulk_send(s, st, amts)
-            init_mask = jnp.any(
-                jnp.arange(self.topo.n, dtype=_i32)[None, :]
-                == snaps[:, None], axis=0)
-            s = self._bulk_snapshots(s, st, init_mask)
-            return self._sync_tick(s, st), None
+            return self._storm_phase(s, st, xs[0], xs[1]), None
 
         s, _ = lax.scan(phase, s, (amounts, snap))
+        return self._wrap(self._drain_flush(s, st), wrap_specs)
+
+    def _storm_phase(self, s: ShardedState, st: ShardedTopology,
+                     amts, snaps) -> ShardedState:
+        """One storm phase: bulk sends + scheduled snapshot initiations +
+        one sync tick (shared by the single-instance and batched bodies)."""
+        s = self._bulk_send(s, st, amts)
+        init_mask = jnp.any(
+            jnp.arange(self.topo.n, dtype=_i32)[None, :]
+            == snaps[:, None], axis=0)
+        s = self._bulk_snapshots(s, st, init_mask)
+        return self._sync_tick(s, st)
+
+    def _drain_flush(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
+        """Tick until every started snapshot completes (budgeted), then
+        max_delay+1 flush ticks (test_common.go:124-137)."""
         limit = jnp.asarray(s.time + self.config.max_ticks, _i32)
         s = lax.while_loop(
             lambda s: self._pending(s) & (s.time < limit),
             lambda s: self._sync_tick(s, st), s)
         s = s._replace(error=s.error | jnp.where(
             self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
-        s = lax.fori_loop(0, self.config.max_delay + 1,
-                          lambda _, s: self._sync_tick(s, st), s)
-        return self._wrap(s, wrap_specs)
+        return lax.fori_loop(0, self.config.max_delay + 1,
+                             lambda _, s: self._sync_tick(s, st), s)
+
+    def _run_script_body(self, s: ShardedState, st: ShardedTopology,
+                         script: ShardedScript) -> ShardedState:
+        """Event-script execution: per phase, apply up to K ops in script
+        order, then tick. Both op kinds run every slot as masked dense
+        updates (a no-op slot still executes its collectives), keeping one
+        uniform SPMD schedule across shards."""
+        from chandy_lamport_tpu.parallel.batch import OP_SEND, OP_SNAPSHOT
+
+        wrap_specs = self._state_specs
+        s = self._unwrap(s, wrap_specs)
+        st = self._unwrap(st, self._topo_specs)
+        my = lax.axis_index(self.axis)
+        nn = jnp.arange(self.topo.n, dtype=_i32)
+
+        def phase(s, xs):
+            kind, shard, loc, arg, do_tick = xs
+
+            def op(j, s):
+                send = kind[j] == OP_SEND
+                s = self._inject_send_local(s, st, loc[j], arg[j],
+                                            send & (shard[j] == my))
+                snap_mask = (kind[j] == OP_SNAPSHOT) & (nn == loc[j])
+                return self._bulk_snapshots(s, st, snap_mask)
+
+            s = lax.fori_loop(0, kind.shape[0], op, s)
+            # do_tick is replicated, so the cond branch (which contains
+            # collectives) is uniform across shards
+            return lax.cond(do_tick != 0,
+                            lambda s: self._sync_tick(s, st),
+                            lambda s: s, s), None
+
+        s, _ = lax.scan(phase, s, tuple(script))
+        return self._wrap(self._drain_flush(s, st), wrap_specs)
+
+    def run_script(self, state: ShardedState, events) -> ShardedState:
+        """Execute an event script (reference .events semantics under the
+        sync scheduler) + drain + flush, SPMD over the graph mesh. With
+        fixed_delay this is bit-comparable to the unsharded sync backend
+        (tests/test_graphshard_script.py)."""
+        return self._run_script(state, self.stopo_device(),
+                                self.compile_script(events))
 
     def run_storm(self, state: ShardedState, amounts: np.ndarray,
                   snap: np.ndarray) -> ShardedState:
@@ -467,6 +630,125 @@ class GraphShardedRunner:
         amounts_s, snap_r = self.shard_program(np.asarray(amounts),
                                                np.asarray(snap))
         return self._run(state, self.stopo_device(), (amounts_s, snap_r))
+
+    # -- combined data x graph mode: B lanes of giant sharded instances ----
+
+    def init_batch(self, batch: int, data_axis: str = "data") -> ShardedState:
+        """Batched state: every leaf gains a leading lane axis sharded over
+        ``data_axis``; graph-sharded leaves keep their shard axis second
+        ([B, P, ...] with spec P(data, graph)). Per-(lane, shard) delay
+        keys."""
+        single = jax.device_get(self.init_state())
+        p = self.shards
+        base = jax.random.PRNGKey(self.seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(batch * p, dtype=jnp.uint32)).reshape(batch, p, -1)
+        batched = jax.tree_util.tree_map(
+            lambda x: np.broadcast_to(np.asarray(x),
+                                      (batch,) + np.shape(x)).copy(),
+            single._replace(delay_key=np.zeros((p, 1), np.uint32)))
+        batched = batched._replace(delay_key=keys)
+        return jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(
+                jnp.asarray(x),
+                NamedSharding(self.mesh, self._batched_spec(sp, data_axis))),
+            batched, self._state_specs)
+
+    @staticmethod
+    def _batched_spec(sp, data_axis):
+        return (P(data_axis, *sp) if sp else P(data_axis))
+
+    def run_storm_batched(self, state: ShardedState, amounts: np.ndarray,
+                          snap: np.ndarray,
+                          data_axis: str = "data") -> ShardedState:
+        """B independent lanes, each a full graph-sharded instance: the
+        combined data x graph 2-D-mesh mode. The lane axis shards over
+        ``data_axis`` (zero cross-lane communication); within each lane the
+        per-tick collectives ride the ``graph`` axis exactly as in
+        run_storm."""
+        if data_axis not in self._run_batched_cache:
+            from functools import partial
+
+            state_specs = jax.tree_util.tree_map(
+                lambda sp: self._batched_spec(sp, data_axis),
+                self._state_specs)
+            smap = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+            self._run_batched_cache[data_axis] = jax.jit(smap(
+                partial(self._run_storm_body_batched, data_axis=data_axis),
+                in_specs=(state_specs, self._topo_specs,
+                          (P(None, self.axis), P())),
+                out_specs=state_specs))
+        amounts_s, snap_r = self.shard_program(np.asarray(amounts),
+                                               np.asarray(snap))
+        return self._run_batched_cache[data_axis](
+            state, self.stopo_device(), (amounts_s, snap_r))
+
+    def _run_storm_body_batched(self, s: ShardedState, st: ShardedTopology,
+                                program, data_axis: str) -> ShardedState:
+        sharded = P(self.axis)
+        st = self._unwrap(st, self._topo_specs)
+        amounts, snap = program          # [T, 1, Em] local slice, [T, J]
+        amounts = amounts[:, 0, :]
+
+        # strip the graph-shard singleton (now axis 1, after the local lane
+        # block) so the per-lane kernel sees per-shard logical shapes
+        s = jax.tree_util.tree_map(
+            lambda x, sp: x[:, 0] if sp == sharded else x,
+            s, self._state_specs)
+
+        def one_lane(s):
+            def phase(s, xs):
+                return self._storm_phase(s, st, xs[0], xs[1]), None
+
+            s, _ = lax.scan(phase, s, (amounts, snap))
+            return self._drain_flush(s, st)
+
+        s = jax.vmap(one_lane)(s)
+        return jax.tree_util.tree_map(
+            lambda x, sp: x[:, None] if sp == sharded else x,
+            s, self._state_specs)
+
+    def gather_dense(self, final: ShardedState):
+        """De-shard a finished ShardedState into a host DenseState (global
+        node/edge order) — the reference's CollectSnapshot gather
+        (sim.go:134-173) as pure numpy reindexing. The result feeds
+        core.state.decode_snapshot and differential comparisons against the
+        unsharded backends."""
+        from chandy_lamport_tpu.core.state import DenseState
+
+        h = jax.device_get(final)
+        p, es, el = self.shards, self.edge_shard, self.edge_local
+
+        def nodes(x):   # [P, .., Nl] -> [.., N]
+            return np.concatenate([x[i] for i in range(p)], axis=-1)
+
+        def edges(x):   # [P, Em, ...] -> [E, ...]
+            return np.asarray(x)[es, el]
+
+        def slot_edges(x):  # [P, S, Em, ...] -> [S, E, ...]
+            return np.moveaxis(np.asarray(x)[es, :, el], 1, 0)
+
+        return DenseState(
+            time=np.asarray(h.time),
+            tokens=nodes(h.tokens),
+            q_marker=edges(h.q_marker),
+            q_data=edges(h.q_data),
+            q_rtime=edges(h.q_rtime),
+            q_head=edges(h.q_head),
+            q_len=edges(h.q_len),
+            next_sid=np.asarray(h.next_sid),
+            started=np.asarray(h.started),
+            has_local=nodes(h.has_local),
+            frozen=nodes(h.frozen),
+            rem=nodes(h.rem),
+            done_local=nodes(h.done_local),
+            recording=slot_edges(h.recording),
+            rec_len=slot_edges(h.rec_len),
+            rec_data=slot_edges(h.rec_data),
+            completed=np.asarray(h.completed),
+            delay_state=(),
+            error=np.asarray(h.error),
+        )
 
     def stopo_device(self) -> ShardedTopology:
         if not hasattr(self, "_stopo_dev"):

@@ -12,9 +12,9 @@ TPU-native equivalent of its scale-out story is SPMD over a
     psum/all-reduce over ICI within a slice, DCN across slices under the
     standard JAX multi-host runtime);
   - giant single graphs (node/edge axes too big for one device) are the
-    tensor-parallel analogue — planned as a shard_map tick with a
-    ppermute edge exchange; until then the instance axis is the scaling
-    dimension (BASELINE.md configs 2-5).
+    tensor-parallel analogue — implemented in ``parallel/graphshard.py``:
+    node/edge state sharded over a ``"graph"`` mesh axis with psum/all_gather
+    collectives per tick, bit-equal to the unsharded sync scheduler.
 
 Everything here works identically on a real TPU slice and on the CPU
 ``--xla_force_host_platform_device_count`` virtual mesh the tests use.

@@ -1,0 +1,96 @@
+"""Multi-host (multi-slice / DCN) execution support.
+
+The reference is strictly single-process (SURVEY.md §2.4 — its only
+"distributed backend" is in-process goroutines); the TPU framework's scale
+story crosses hosts: a v5e pod slice gives each host a process and 4-8 local
+chips, slices connect over DCN, and JAX's multi-controller runtime makes
+``jax.devices()`` span all of them after ``jax.distributed.initialize``.
+
+How the framework's axes map onto that fabric:
+
+  - **instance axis (data parallel)** — embarrassingly parallel; shard it
+    across EVERYTHING (all hosts, all slices). Cross-device traffic is zero
+    in steady state and one psum at metric collection, so DCN's lower
+    bandwidth vs ICI is irrelevant. This is the intended multi-host scaling
+    path for 1M-instance runs (BASELINE.md config 5).
+  - **graph axis (the TP analogue, parallel/graphshard.py)** — per-tick
+    psum/all_gather traffic; keep it INSIDE a slice so collectives ride ICI.
+    On a 2-D (data x graph) mesh put ``data`` outermost (across
+    hosts/slices) and ``graph`` innermost (within a slice) — exactly the
+    hybrid-mesh recipe for DCN-connected slices.
+
+Usage (one process per host, e.g. under SLURM/GKE):
+
+    from chandy_lamport_tpu.parallel import multihost
+    multihost.initialize()                 # env-driven; no-op single-process
+    mesh = multihost.hybrid_mesh(graph=4)  # data spans hosts, graph intra-slice
+
+Everything degrades gracefully to single-process: ``initialize()`` without a
+coordinator is a no-op, and ``hybrid_mesh`` falls back to all local devices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Bring up JAX's multi-controller runtime (one call per host process,
+    before any backend use). Arguments default from the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, or the
+    cluster auto-detection jax.distributed supports natively). Returns True
+    if distributed mode was initialized, False for the single-process
+    no-op."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process: nothing to do
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def hybrid_mesh(graph: int = 1, data_axis: str = "data",
+                graph_axis: str = "graph"):
+    """2-D (data x graph) mesh over ALL devices (all hosts after
+    initialize()): ``graph`` is the innermost axis so its per-tick
+    collectives stay on ICI within a host/slice; ``data`` spans the rest of
+    the fabric including DCN. ``graph`` must divide the per-process device
+    count so no graph group crosses a process boundary."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    local = len(jax.local_devices())
+    if graph < 1 or len(devs) % graph:
+        raise ValueError(f"graph={graph} must divide {len(devs)} devices")
+    if local % graph:
+        raise ValueError(
+            f"graph={graph} must divide the {local} per-process devices so "
+            f"graph collectives stay inside one host's ICI domain")
+    arr = np.array(devs).reshape(len(devs) // graph, graph)
+    return Mesh(arr, (data_axis, graph_axis))
+
+
+def process_info() -> dict:
+    """Host-side observability: this process's rank/size and device split."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -54,6 +54,11 @@ def load_state(path: str, like: DenseState) -> Tuple[DenseState, dict]:
                              f"{header['format_version']}")
         leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if header["treedef"] != str(treedef):
+        raise ValueError(
+            f"checkpoint treedef {header['treedef']!r} != expected "
+            f"{str(treedef)!r} — different state structure (backend/delay "
+            f"model mismatch?)")
     if len(like_leaves) != len(leaves):
         raise ValueError(
             f"checkpoint has {len(leaves)} leaves, expected "
@@ -63,4 +68,8 @@ def load_state(path: str, like: DenseState) -> Tuple[DenseState, dict]:
             raise ValueError(
                 f"leaf {i}: checkpoint shape {np.shape(a)} != expected "
                 f"{np.shape(b)} — topology/config/batch mismatch?")
+        if np.dtype(np.asarray(a).dtype) != np.dtype(np.asarray(b).dtype):
+            raise ValueError(
+                f"leaf {i}: checkpoint dtype {np.asarray(a).dtype} != "
+                f"expected {np.asarray(b).dtype}")
     return jax.tree_util.tree_unflatten(treedef, leaves), header["meta"]

@@ -69,5 +69,46 @@ def progress_counters(state: DenseState, cfg: SimConfig,
         "snapshots_pending": jnp.sum(started & ~complete),
         "nodes_finalized": jnp.sum(state.done_local),
         "recorded_messages": jnp.sum(state.rec_len),
-        "error_bits": jnp.max(state.error),
+        # bitwise OR over instances (jnp.max would drop bits when different
+        # lanes carry different error flags)
+        "error_bits": or_reduce(state.error),
     }
+
+
+def instance_footprint_bytes(num_nodes: int, num_edges: int,
+                             cfg: SimConfig) -> int:
+    """Per-instance HBM bytes of a DenseState (excluding delay state):
+    the capacity-planning formula behind BASELINE.md's max-batch numbers.
+
+    footprint = 9·E·C + 8·E + 4·N + S·(1 + 10·N + E·(5 + 4·M))
+
+    Dominant term at bench shapes is the recorded-message buffer
+    ``rec_data[S, E, M]`` (4·S·E·M) plus the ``[S, E]`` recording planes —
+    size S and M to the workload, not to the worst case.
+    """
+    n, e = num_nodes, num_edges
+    c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
+    queues = e * c * (1 + 4 + 4) + e * (4 + 4)          # q_* rings + head/len
+    nodes = 4 * n                                       # tokens
+    snaps = s * (1 + n * (1 + 4 + 4 + 1) + e * (1 + 4 + 4 * m))
+    scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
+    return queues + nodes + snaps + scalars
+
+
+def max_batch_estimate(num_nodes: int, num_edges: int, cfg: SimConfig,
+                       hbm_bytes: int, working_set_factor: float = 2.0) -> int:
+    """Instances that fit one chip's HBM: capacity / (footprint × factor).
+    ``working_set_factor`` accounts for XLA's double-buffering of the loop
+    carry (donation halves it; 2.0 is the observed-safe default)."""
+    per = instance_footprint_bytes(num_nodes, num_edges, cfg)
+    return max(1, int(hbm_bytes / (per * working_set_factor)))
+
+
+def or_reduce(mask) -> jnp.ndarray:
+    """Bitwise-OR reduction of an integer bitmask over all axes."""
+    mask = jnp.asarray(mask)
+    bits = jnp.iinfo(mask.dtype).bits
+    shifts = jnp.arange(bits, dtype=mask.dtype)
+    any_bit = jnp.any((mask[..., None] >> shifts) & 1,
+                      axis=tuple(range(mask.ndim)))
+    return jnp.sum(jnp.where(any_bit, 1, 0).astype(mask.dtype) << shifts)

@@ -6,9 +6,9 @@ note sends record the balance *before* the debit, node.go:118-120). Pretty
 printing matches the reference's record strings (common.go:75-122).
 
 For the JAX backend, structured per-event capture is incompatible with jit;
-its equivalents are (a) aggregate per-tick counters returned as arrays
-(ops/tick.py TickStats) and (b) ``jax.profiler`` for kernel-level timing
-(SURVEY.md §5).
+its equivalents are (a) aggregate counters reduced from DenseState
+(utils/metrics.py progress_counters) and (b) ``jax.profiler`` traces via
+``bench --profile`` for kernel-level timing (SURVEY.md §5).
 """
 
 from __future__ import annotations

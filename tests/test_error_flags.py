@@ -1,0 +1,221 @@
+"""Every ERR_* bit of the sticky error bitmask must actually fire.
+
+The bitmask is the framework's sanitizer (core/state.py): it replaces the
+reference's log.Fatal paths (node.go:113-116, sim.go:49-54) and the silent
+unbounded growth of Go's queues/maps/lists with explicit capacity checks.
+Round-1 tests only ever asserted ``error == 0``; these tests drive each
+overflow/underflow predicate over the edge on BOTH the dense (single-instance
+and batched) and graph-sharded paths, so an off-by-one in any predicate
+cannot ship silently.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.dense import DenseBackendError, DenseSim
+from chandy_lamport_tpu.core.spec import (
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.core.state import (
+    ERR_QUEUE_OVERFLOW,
+    ERR_RECORD_OVERFLOW,
+    ERR_SNAPSHOT_OVERFLOW,
+    ERR_TICK_LIMIT,
+    ERR_TOKEN_UNDERFLOW,
+    ERR_VALUE_OVERFLOW,
+    F32_EXACT_LIMIT,
+    decode_errors,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner, compile_events
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+
+def _pair(tokens=100):
+    """Strongly connected 2-node pair."""
+    return TopologySpec([("N1", tokens), ("N2", 0)],
+                        [("N1", "N2"), ("N2", "N1")])
+
+
+def _err(sim: DenseSim) -> int:
+    return int(jax.device_get(sim.state.error))
+
+
+# ---------------------------------------------------------------------------
+# dense single-instance kernel
+# ---------------------------------------------------------------------------
+
+def test_queue_overflow_fires():
+    sim = DenseSim(_pair(), FixedJaxDelay(1), SimConfig(queue_capacity=1))
+    sim.process_event(PassTokenEvent("N1", "N2", 1))
+    assert _err(sim) == 0  # exactly at capacity: no flag
+    sim.process_event(PassTokenEvent("N1", "N2", 1))
+    assert _err(sim) & ERR_QUEUE_OVERFLOW
+
+
+def test_token_underflow_fires():
+    sim = DenseSim(_pair(tokens=3), FixedJaxDelay(1), SimConfig())
+    sim.process_event(PassTokenEvent("N1", "N2", 3))
+    assert _err(sim) == 0  # sending the exact balance is legal
+    sim.process_event(PassTokenEvent("N1", "N2", 1))
+    assert _err(sim) & ERR_TOKEN_UNDERFLOW
+
+
+def test_snapshot_overflow_fires():
+    sim = DenseSim(_pair(), FixedJaxDelay(1), SimConfig(max_snapshots=1))
+    sim.process_event(SnapshotEvent("N1"))
+    assert _err(sim) == 0
+    sim.process_event(SnapshotEvent("N2"))
+    assert _err(sim) & ERR_SNAPSHOT_OVERFLOW
+
+
+def test_record_overflow_fires():
+    """With M=1 and three sends queued ahead of the re-broadcast marker, the
+    recording channel N1->N2 must overflow its record buffer."""
+    sim = DenseSim(_pair(), FixedJaxDelay(1), SimConfig(max_recorded=1))
+    sim.process_event(SnapshotEvent("N2"))
+    for _ in range(3):
+        sim.process_event(PassTokenEvent("N1", "N2", 1))
+    sim.process_event(TickEvent(6))
+    assert _err(sim) & ERR_RECORD_OVERFLOW
+
+
+def test_tick_limit_fires_on_non_strongly_connected_graph():
+    """N2 has no outbound link, so the initiator N1 never receives a marker
+    back and never finalizes — the reference would hang in its drain loop
+    (sim.go:116-117 waits on ALL nodes); the kernel hits the tick budget."""
+    spec = TopologySpec([("N1", 10), ("N2", 0)], [("N1", "N2")])
+    sim = DenseSim(spec, FixedJaxDelay(1), SimConfig(max_ticks=50))
+    with pytest.raises(DenseBackendError, match="max_ticks"):
+        sim.run_events([SnapshotEvent("N1"), TickEvent(1)])
+    assert _err(sim) & ERR_TICK_LIMIT
+
+
+def test_decode_errors_names_every_bit():
+    bits = (ERR_QUEUE_OVERFLOW | ERR_SNAPSHOT_OVERFLOW | ERR_RECORD_OVERFLOW
+            | ERR_TOKEN_UNDERFLOW | ERR_TICK_LIMIT | ERR_VALUE_OVERFLOW)
+    assert len(decode_errors(bits)) == 6
+
+
+# ---------------------------------------------------------------------------
+# batched sync scheduler
+# ---------------------------------------------------------------------------
+
+def test_value_overflow_fires_on_sync_scheduler():
+    """A token amount at the f32-exactness limit must flag, not silently
+    violate conservation (ADVICE round 1: f32 incidence matmuls are exact
+    only below 2^24)."""
+    spec = _pair(tokens=F32_EXACT_LIMIT + 10)
+    runner = BatchedRunner(spec, SimConfig(), FixedJaxDelay(1), batch=2,
+                           scheduler="sync")
+    script = compile_events(runner.topo, [
+        PassTokenEvent("N1", "N2", F32_EXACT_LIMIT), TickEvent(2)])
+    final = jax.device_get(runner.run(runner.init_batch(), script))
+    assert np.all(final.error & ERR_VALUE_OVERFLOW)
+
+
+def test_value_overflow_absent_below_limit():
+    spec = _pair(tokens=F32_EXACT_LIMIT + 10)
+    runner = BatchedRunner(spec, SimConfig(), FixedJaxDelay(1), batch=2,
+                           scheduler="sync")
+    script = compile_events(runner.topo, [
+        PassTokenEvent("N1", "N2", F32_EXACT_LIMIT - 1), TickEvent(2)])
+    final = jax.device_get(runner.run(runner.init_batch(), script))
+    assert int(final.error.sum()) == 0
+    assert int(final.tokens[0, 1]) == F32_EXACT_LIMIT - 1  # delivered exactly
+
+
+def test_batched_error_lanes_reported():
+    """Per-lane sticky errors surface in summarize()."""
+    spec = _pair(tokens=1)
+    runner = BatchedRunner(spec, SimConfig(), FixedJaxDelay(1), batch=4,
+                           scheduler="sync")
+    script = compile_events(runner.topo, [
+        PassTokenEvent("N1", "N2", 5), TickEvent(2)])
+    final = runner.run(runner.init_batch(), script)
+    assert BatchedRunner.summarize(final)["error_lanes"] == 4
+
+
+# ---------------------------------------------------------------------------
+# graph-sharded path (2 shards on the virtual CPU mesh)
+# ---------------------------------------------------------------------------
+
+def _gs(spec, cfg, **kw):
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    from chandy_lamport_tpu.parallel.mesh import instance_mesh
+
+    mesh = instance_mesh(2, axis_name="graph")
+    return GraphShardedRunner(spec, cfg, mesh, fixed_delay=kw.pop("fixed_delay", 1),
+                              **kw)
+
+
+def _ring4(tokens=100):
+    ids = ["N1", "N2", "N3", "N4"]
+    return TopologySpec([(i, tokens) for i in ids],
+                        [(ids[i], ids[(i + 1) % 4]) for i in range(4)])
+
+
+def _gs_err(runner, final) -> int:
+    return int(jax.device_get(final.error))
+
+
+def test_graphshard_queue_overflow_fires():
+    gs = _gs(_ring4(), SimConfig(queue_capacity=1), fixed_delay=4)
+    amounts = np.ones((3, gs.topo.e), np.int32)  # 3 phases of sends, slow net
+    snap = np.full((3, 1), -1, np.int32)
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_QUEUE_OVERFLOW
+
+
+def test_graphshard_token_underflow_fires():
+    gs = _gs(_ring4(tokens=1), SimConfig())
+    amounts = np.full((2, gs.topo.e), 5, np.int32)
+    snap = np.full((2, 1), -1, np.int32)
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_TOKEN_UNDERFLOW
+
+
+def test_graphshard_snapshot_overflow_fires():
+    gs = _gs(_ring4(), SimConfig(max_snapshots=1))
+    amounts = np.zeros((2, gs.topo.e), np.int32)
+    snap = np.array([[0], [1]], np.int32)  # two initiations, one slot
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_SNAPSHOT_OVERFLOW
+
+
+def test_graphshard_record_overflow_fires():
+    """Marker takes 4 hops around the ring; the recorded channel sees a
+    token every phase meanwhile — M=1 must overflow."""
+    gs = _gs(_ring4(), SimConfig(max_recorded=1))
+    amounts = np.ones((6, gs.topo.e), np.int32)
+    snap = np.full((6, 1), -1, np.int32)
+    snap[0, 0] = 0
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_RECORD_OVERFLOW
+
+
+def test_graphshard_tick_limit_fires():
+    """N4 has an outbound arc but no inbound arc: markers never reach it, the
+    snapshot can never complete on all 4 nodes, the drain hits max_ticks."""
+    spec = TopologySpec(
+        [("N1", 10), ("N2", 10), ("N3", 10), ("N4", 10)],
+        [("N1", "N2"), ("N2", "N3"), ("N3", "N1"), ("N4", "N1")])
+    gs = _gs(spec, SimConfig(max_ticks=50))
+    amounts = np.zeros((1, gs.topo.e), np.int32)
+    snap = np.array([[0]], np.int32)
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_TICK_LIMIT
+
+
+def test_graphshard_value_overflow_fires():
+    gs = _gs(_ring4(tokens=F32_EXACT_LIMIT + 10), SimConfig())
+    amounts = np.zeros((2, gs.topo.e), np.int32)
+    amounts[0, 0] = F32_EXACT_LIMIT
+    snap = np.full((2, 1), -1, np.int32)
+    final = gs.run_storm(gs.init_state(), amounts, snap)
+    assert _gs_err(gs, final) & ERR_VALUE_OVERFLOW

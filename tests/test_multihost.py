@@ -1,0 +1,33 @@
+"""Multi-host helpers: single-process no-op init + hybrid mesh shapes on the
+virtual 8-device CPU mesh (real DCN needs multiple hosts; the mesh/axes
+logic is what's testable here and what the driver's dryrun exercises)."""
+
+import jax
+import pytest
+
+from chandy_lamport_tpu.parallel import multihost
+
+
+def test_initialize_is_noop_without_coordinator(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost.initialize() is False
+
+
+def test_hybrid_mesh_axes():
+    mesh = multihost.hybrid_mesh(graph=2)
+    assert mesh.shape["graph"] == 2
+    assert mesh.shape["data"] == len(jax.devices()) // 2
+    assert tuple(mesh.axis_names) == ("data", "graph")
+
+
+def test_hybrid_mesh_rejects_bad_split():
+    with pytest.raises(ValueError):
+        multihost.hybrid_mesh(graph=3)  # does not divide 8
+
+
+def test_process_info_single_process():
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["local_devices"] == info["global_devices"] == len(jax.devices())
