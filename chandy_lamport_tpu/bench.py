@@ -47,6 +47,9 @@ def log(msg: str) -> None:
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="bench")
     p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--graph", choices=["sf", "ring", "er"], default="sf",
+                   help="topology family: scale-free (config 4), ring "
+                        "(config 2), Erdős–Rényi avg-degree 3 (config 3)")
     p.add_argument("--attach", type=int, default=2, help="scale-free out-arcs per node")
     p.add_argument("--batch", type=int, default=2048, help="vmap'd instances")
     p.add_argument("--phases", type=int, default=32, help="storm phases (ticks with traffic)")
@@ -101,6 +104,8 @@ def run_worker(args) -> int:
 
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
         scale_free,
         staggered_snapshots,
         storm_program,
@@ -109,10 +114,16 @@ def run_worker(args) -> int:
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
 
     log(f"device: {dev.platform} ({dev.device_kind}); "
-        f"N={args.nodes} B={args.batch} phases={args.phases} "
-        f"scheduler={args.scheduler}")
+        f"graph={args.graph} N={args.nodes} B={args.batch} "
+        f"phases={args.phases} scheduler={args.scheduler}")
 
-    spec = scale_free(args.nodes, args.attach, seed=3, tokens=args.phases + 10)
+    tokens = args.phases + 10
+    if args.graph == "ring":
+        spec = ring_topology(args.nodes, tokens=tokens)
+    elif args.graph == "er":
+        spec = erdos_renyi(args.nodes, 3.0, seed=3, tokens=tokens)
+    else:
+        spec = scale_free(args.nodes, args.attach, seed=3, tokens=tokens)
     cfg = SimConfig(queue_capacity=16, max_snapshots=max(8, args.snapshots),
                     max_recorded=16)
     runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
@@ -174,6 +185,7 @@ def run_worker(args) -> int:
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "scheduler": args.scheduler,
+        "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
     }
@@ -221,20 +233,20 @@ def _run_attempt(name, env_overrides, extra, timeout, argv):
                               timeout=timeout)
     except subprocess.TimeoutExpired:
         log(f"attempt '{name}' timed out after {timeout:.0f}s")
-        return None, True
+        return None, True, True
     out = proc.stdout.decode(errors="replace").strip().splitlines()
     if proc.returncode == 0 and out:
         try:
             parsed = json.loads(out[-1])
             parsed["attempt"] = name
-            return parsed, False
+            return parsed, False, False
         except json.JSONDecodeError:
             log(f"attempt '{name}': unparseable stdout {out[-1]!r}")
-            return None, False
+            return None, False, False
     retryable = proc.returncode in (EXIT_BACKEND_INIT, -6, -9, -11)
     log(f"attempt '{name}' failed rc={proc.returncode} "
         f"(retryable={retryable})")
-    return None, retryable
+    return None, retryable, False
 
 
 def main(argv=None) -> int:
@@ -244,9 +256,16 @@ def main(argv=None) -> int:
         return run_worker(args)
 
     argv = [a for a in argv if a != "--worker"]
+    saw_hang = False
     for name, env_overrides, extra, timeout in _attempts(args):
-        parsed, retryable = _run_attempt(name, env_overrides, extra,
-                                         timeout, argv)
+        if name == "auto" and saw_hang:
+            # the default attempt HUNG (plugin tunnel stuck) — a second
+            # full-size attempt would hang identically; go straight to CPU
+            log("skipping 'auto' attempt after a hang")
+            continue
+        parsed, retryable, timed_out = _run_attempt(name, env_overrides,
+                                                    extra, timeout, argv)
+        saw_hang = saw_hang or timed_out
         if parsed is not None:
             print(json.dumps(parsed), flush=True)
             return 0

@@ -67,7 +67,8 @@ def _cmd_test(args) -> int:
             for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
                 assert_snapshots_equal(e, a)
             print(f"PASS {name}")
-        except (SnapshotMismatch, AssertionError, ValueError, OSError) as exc:
+        except (SnapshotMismatch, AssertionError, ValueError, OSError,
+                RuntimeError) as exc:  # RuntimeError covers DenseBackendError
             failures += 1
             print(f"FAIL {name}: {exc}")
     print(f"{len(REFERENCE_TESTS) - failures}/{len(REFERENCE_TESTS)} passed")
@@ -174,6 +175,10 @@ def main(argv=None) -> int:
 
     platform = args.platform or os.environ.get("CLSIM_PLATFORM")
     if platform:
+        # env var too: the bench subcommand runs its measurement in worker
+        # subprocesses that read CLSIM_PLATFORM (the parent's jax.config
+        # doesn't reach them)
+        os.environ["CLSIM_PLATFORM"] = platform
         import jax
 
         jax.config.update("jax_platforms", platform)

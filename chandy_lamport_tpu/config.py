@@ -34,10 +34,17 @@ class SimConfig:
     max_recorded: int = 32         # recorded messages per (snapshot, edge) (M)
     max_delay: int = MAX_DELAY
     max_ticks: int = 100_000       # drain-loop budget (guards non-strongly-connected graphs)
+    # dtype of the recorded-message buffer rec_data[S, E, M] — the dominant
+    # per-instance HBM term (utils/metrics.instance_footprint_bytes). int16
+    # halves it and roughly doubles the max batch; amounts beyond the dtype's
+    # range fire ERR_VALUE_OVERFLOW instead of truncating silently.
+    record_dtype: str = "int32"
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
             raise ValueError("capacities must be positive")
+        if self.record_dtype not in ("int32", "int16"):
+            raise ValueError("record_dtype must be 'int32' or 'int16'")
 
 
 DEFAULT_CONFIG = SimConfig()

@@ -60,8 +60,10 @@ ERROR_NAMES = {
     ERR_RECORD_OVERFLOW: "recorded-message capacity exceeded (raise SimConfig.max_recorded)",
     ERR_TOKEN_UNDERFLOW: "node sent more tokens than it had (reference log.Fatal, node.go:113-116)",
     ERR_TICK_LIMIT: "drain loop hit max_ticks (graph not strongly connected?)",
-    ERR_VALUE_OVERFLOW: "token amount >= 2^24 on the sync scheduler (f32 "
-                        "reductions no longer exact; use scheduler='exact')",
+    ERR_VALUE_OVERFLOW: "token amount exceeded a numeric-exactness bound: "
+                        ">= 2^24 on the sync scheduler's f32 reductions "
+                        "(use scheduler='exact'), or beyond the configured "
+                        "record_dtype range (use record_dtype='int32')",
 }
 
 
@@ -155,7 +157,7 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         done_local=np.zeros((s, n), b),
         recording=np.zeros((s, e), b),
         rec_len=np.zeros((s, e), i32),
-        rec_data=np.zeros((s, e, m), i32),
+        rec_data=np.zeros((s, e, m), np.dtype(cfg.record_dtype)),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
         error=np.int32(0),

@@ -50,6 +50,23 @@ from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 
 _i32 = jnp.int32
 
+# bf16 holds integers exactly through this bound; count matmuls whose
+# outputs provably stay within it may run in bf16 on the MXU
+BF16_EXACT_COUNT = 256
+
+
+def count_dtype(topo: DenseTopology):
+    """Dtype for 0/1 COUNT incidence matmuls (marker arrivals, created
+    masks, same-source priors): bf16 on TPU when the graph's degree bound
+    proves every output <= 256 (so bf16 is exact), else f32. Shared by
+    TickKernel and GraphShardedRunner so the numeric-exactness gate cannot
+    drift between the two paths. Token-AMOUNT reductions must never use
+    this — they stay f32/int guarded by F32_EXACT_LIMIT."""
+    degree_bound = max(int(topo.in_degree.max()) if topo.e else 0, topo.d)
+    if jax.default_backend() == "tpu" and degree_bound <= BF16_EXACT_COUNT:
+        return jnp.bfloat16
+    return jnp.float32
+
 
 class TickKernel:
     """Jitted closures over a fixed (topology, config, delay sampler).
@@ -87,9 +104,21 @@ class TickKernel:
         a_out[topo.edge_src, _np.arange(e)] = 1.0  # A_out @ x_e = per-src sum
         prior = ((topo.edge_src[None, :] == topo.edge_src[:, None])
                  & (_np.arange(e)[None, :] < _np.arange(e)[:, None]))
+        # COUNT matmuls run in bf16 on TPU for 2x MXU throughput when the
+        # degree bound proves them exact (count_dtype above). Token-amount
+        # matmuls always stay f32 (guarded by F32_EXACT_LIMIT), which is why
+        # _A_in exists in both dtypes; _A_out/_L_prior have no
+        # amount-carrying use, so only the count-dtype copies are kept.
+        self._cnt = count_dtype(topo)
+        # recorded amounts beyond the record dtype's range must flag, not
+        # silently truncate (record_dtype shrinks rec_data[S, E, M] HBM)
+        self._rec_dtype = jnp.dtype(cfg.record_dtype)
+        self._rec_limit = jnp.iinfo(self._rec_dtype).max
         self._A_in = jnp.asarray(a_in)
-        self._A_out = jnp.asarray(a_out)
-        self._L_prior = jnp.asarray(prior.astype(_np.float32))
+        self._A_in_c = (self._A_in if self._cnt == jnp.float32
+                        else jnp.asarray(a_in, self._cnt))
+        self._A_out_c = jnp.asarray(a_out, self._cnt)
+        self._L_prior_c = jnp.asarray(prior, self._cnt)
         self.tick = jax.jit(self._tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
@@ -184,11 +213,15 @@ class TickKernel:
         pos = jnp.clip(s.rec_len[:, e], 0, M - 1)      # [S]
         rows = jnp.arange(S)
         col = s.rec_data[:, e, :]                      # [S, M]
+        amount_r = jnp.asarray(amount, self._rec_dtype)
         col = col.at[rows, pos].set(
-            jnp.where(cond, jnp.asarray(amount, _i32), col[rows, pos]))
+            jnp.where(cond, amount_r, col[rows, pos]))
         err = s.error | jnp.where(
             jnp.any(cond & (s.rec_len[:, e] >= M)), ERR_RECORD_OVERFLOW, 0
         ).astype(_i32)
+        err = err | jnp.where(
+            jnp.any(cond) & (jnp.asarray(amount, _i32) > self._rec_limit),
+            ERR_VALUE_OVERFLOW, 0).astype(_i32)
         return s._replace(
             tokens=s.tokens.at[dst].add(jnp.asarray(amount, _i32)),
             rec_data=s.rec_data.at[:, e, :].set(col),
@@ -261,7 +294,7 @@ class TickKernel:
         popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
         popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
         elig_e = (s.q_len > 0) & (head_rt <= time)                # [E]
-        prior = self._L_prior @ elig_e.astype(f32)                # [E]
+        prior = self._L_prior_c @ elig_e.astype(self._cnt)        # [E]
         deliver_e = elig_e & (prior < 0.5)
         s = s._replace(
             q_head=(s.q_head + deliver_e) % C,
@@ -284,11 +317,16 @@ class TickKernel:
         rec_mask = s.recording & tok_e[None, :]                   # [S, E]
         err = s.error | jnp.where(jnp.any(rec_mask & (s.rec_len >= M)),
                                   ERR_RECORD_OVERFLOW, 0).astype(_i32)
+        err = err | jnp.where(
+            jnp.any(rec_mask & (amt_e > self._rec_limit)[None, :]),
+            ERR_VALUE_OVERFLOW, 0).astype(_i32)
         pos = jnp.clip(s.rec_len, 0, M - 1)
         hit_m = rec_mask[:, :, None] & (
             jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
         s = s._replace(
-            rec_data=jnp.where(hit_m, amt_e[None, :, None], s.rec_data),
+            rec_data=jnp.where(hit_m,
+                               amt_e.astype(self._rec_dtype)[None, :, None],
+                               s.rec_data),
             rec_len=s.rec_len + rec_mask.astype(_i32),
             error=err,
         )
@@ -300,11 +338,12 @@ class TickKernel:
         mk_e = deliver_e & popped_marker                          # [E]
         mk_se = mk_e[None, :] & (
             popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, E]
-        arrivals = (mk_se.astype(f32) @ self._A_in.T).astype(_i32)  # [S, N]
+        arrivals = (mk_se.astype(self._cnt)
+                    @ self._A_in_c.T).astype(_i32)                 # [S, N]
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
-        created_f = created.astype(f32)
-        created_dst_se = (created_f @ self._A_in) > 0.5            # [S, E]
+        created_f = created.astype(self._cnt)
+        created_dst_se = (created_f @ self._A_in_c) > 0.5          # [S, E]
         recording = (s.recording | created_dst_se) & ~mk_se
         rem = jnp.where(created, self._in_degree[None, :] - arrivals,
                         s.rem - jnp.where(had, arrivals, 0))
@@ -319,7 +358,7 @@ class TickKernel:
         # ---- re-broadcast from every node that just created its local
         # snapshot (node.StartSnapshot, node.go:198-212): one marker per
         # (slot, outbound edge) in one dense multi-push
-        push_se = (created_f @ self._A_out) > 0.5                  # [S, E]
+        push_se = (created_f @ self._A_out_c) > 0.5                # [S, E]
         payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
                                    push_se.shape)
         s = self._dense_push_multi(s, push_se, payload)
@@ -440,16 +479,15 @@ class TickKernel:
         (slot, node) of ``created`` [S, N] (node.go:58-84 + node.go:97-109):
         freeze balances, record all inbound channels, push one marker per
         outbound edge per created slot."""
-        f32 = jnp.float32
-        created_f = created.astype(f32)
-        created_dst_se = (created_f @ self._A_in) > 0.5            # [S, E]
+        created_f = created.astype(self._cnt)
+        created_dst_se = (created_f @ self._A_in_c) > 0.5          # [S, E]
         s = s._replace(
             recording=s.recording | created_dst_se,
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=jnp.where(created, self._in_degree[None, :], s.rem),
             has_local=s.has_local | created,
         )
-        push_se = (created_f @ self._A_out) > 0.5                  # [S, E]
+        push_se = (created_f @ self._A_out_c) > 0.5                # [S, E]
         payload = jnp.broadcast_to(
             jnp.arange(self.cfg.max_snapshots, dtype=_i32)[:, None],
             push_se.shape)

@@ -59,13 +59,18 @@ _f32 = jnp.float32
 
 
 class ShardedTopology(NamedTuple):
-    """Per-shard topology constants, stacked on the leading shard axis."""
+    """Per-shard topology constants, stacked on the leading shard axis.
+    ``a_in`` stays f32 for the token-amount credit matmul; the ``_c`` copies
+    carry the count-matmul dtype (bf16 on TPU when the degree bound proves
+    counts exact, else aliases of the f32 arrays) so no cast sits inside the
+    scanned tick body."""
 
     edge_src: Any    # i32 [P, Em]  global src node id, -1 pad
     edge_dst: Any    # i32 [P, Em]  global dst node id, -1 pad
     a_in: Any        # f32 [P, N, Em]  one-hot dst incidence (0 for pads)
-    a_src: Any       # f32 [P, N, Em]  one-hot src incidence (0 for pads)
-    l_prior: Any     # f32 [P, Em, Em] same-src strict predecessor
+    a_in_c: Any      # cnt [P, N, Em]
+    a_src_c: Any     # cnt [P, N, Em]  one-hot src incidence (0 for pads)
+    l_prior_c: Any   # cnt [P, Em, Em] same-src strict predecessor
     in_degree: Any   # i32 [N] (replicated)
 
 
@@ -112,9 +117,11 @@ class ShardedState(NamedTuple):
     error: Any       # i32 [] (replicated)
 
 
-def shard_topology(topo: DenseTopology, shards: int) -> Tuple[ShardedTopology, int]:
+def shard_topology(topo: DenseTopology, shards: int,
+                   cnt_dtype=None) -> Tuple[ShardedTopology, int]:
     """Partition nodes into contiguous blocks and edges by source shard;
-    pad per-shard edge arrays to the max local count."""
+    pad per-shard edge arrays to the max local count. ``cnt_dtype`` is the
+    count-matmul dtype for the ``_c`` constants (default f32)."""
     n, e = topo.n, topo.e
     if n % shards:
         raise ValueError(f"nodes ({n}) must divide evenly into {shards} shards")
@@ -141,10 +148,14 @@ def shard_topology(topo: DenseTopology, shards: int) -> Tuple[ShardedTopology, i
         l_prior[p] = ((src_row[None, :] == src_row[:, None])
                       & (src_row[:, None] >= 0)
                       & (np.arange(em)[None, :] < np.arange(em)[:, None]))
+    a_in_f = jnp.asarray(a_in)
+    cnt = jnp.dtype(cnt_dtype) if cnt_dtype is not None else jnp.dtype(jnp.float32)
     return ShardedTopology(
         edge_src=jnp.asarray(edge_src), edge_dst=jnp.asarray(edge_dst),
-        a_in=jnp.asarray(a_in), a_src=jnp.asarray(a_src),
-        l_prior=jnp.asarray(l_prior),
+        a_in=a_in_f,
+        a_in_c=a_in_f if cnt == jnp.float32 else jnp.asarray(a_in, cnt),
+        a_src_c=jnp.asarray(a_src, cnt),
+        l_prior_c=jnp.asarray(l_prior, cnt),
         in_degree=jnp.asarray(topo.in_degree),
     ), em
 
@@ -175,7 +186,14 @@ class GraphShardedRunner:
         if self.config.max_delay != self.max_delay:
             self.config = dataclasses.replace(self.config,
                                               max_delay=self.max_delay)
-        self.stopo, self.em = shard_topology(self.topo, self.shards)
+        # shared numeric-exactness gate with TickKernel (ops/tick.count_dtype)
+        from chandy_lamport_tpu.ops.tick import count_dtype
+
+        self._cnt = count_dtype(self.topo)
+        self._rec_dtype = jnp.dtype(self.config.record_dtype)
+        self._rec_limit = jnp.iinfo(self._rec_dtype).max
+        self.stopo, self.em = shard_topology(self.topo, self.shards,
+                                             cnt_dtype=self._cnt)
         self.nl = self.topo.n // self.shards
 
         # global edge -> (owning shard, local slot) in shard fill order;
@@ -192,7 +210,8 @@ class GraphShardedRunner:
         spec_rep = P()
         topo_specs = ShardedTopology(
             edge_src=spec_sharded, edge_dst=spec_sharded, a_in=spec_sharded,
-            a_src=spec_sharded, l_prior=spec_sharded, in_degree=spec_rep)
+            a_in_c=spec_sharded, a_src_c=spec_sharded, l_prior_c=spec_sharded,
+            in_degree=spec_rep)
         state_specs = ShardedState(
             time=spec_rep, tokens=spec_sharded, q_marker=spec_sharded,
             q_data=spec_sharded, q_rtime=spec_sharded, q_head=spec_sharded,
@@ -246,7 +265,7 @@ class GraphShardedRunner:
             done_local=np.zeros((p, s, nl), np.bool_),
             recording=np.zeros((p, s, em), np.bool_),
             rec_len=np.zeros((p, s, em), np.int32),
-            rec_data=np.zeros((p, s, em, m), np.int32),
+            rec_data=np.zeros((p, s, em, m), np.dtype(self.config.record_dtype)),
             completed=np.zeros(s, np.int32),
             delay_key=keys,
             error=np.int32(0),
@@ -356,8 +375,8 @@ class GraphShardedRunner:
         every created (slot, node); remote creators reach this shard's
         recording flags + queues through the replicated created matrix."""
         S = self.config.max_snapshots
-        created_f = created_global.astype(_f32)
-        created_dst_se = (created_f @ st.a_in) > 0.5        # [S, Em] local
+        created_f = created_global.astype(self._cnt)
+        created_dst_se = (created_f @ st.a_in_c) > 0.5  # [S, Em]
         created_l = self._my_slice(created_global)           # [S, Nl]
         s = s._replace(
             recording=s.recording | created_dst_se,
@@ -366,7 +385,7 @@ class GraphShardedRunner:
                           self._my_slice(st.in_degree[None, :]), s.rem),
             has_local=s.has_local | created_l,
         )
-        push_se = (created_f @ st.a_src) > 0.5               # [S, Em] local
+        push_se = (created_f @ st.a_src_c) > 0.5  # [S, Em]
         payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
                                    push_se.shape)
         return self._dense_push_multi(s, st, push_se, payload)
@@ -376,13 +395,22 @@ class GraphShardedRunner:
         """amounts [Em] local (sends originate on this shard's sources)."""
         amounts = jnp.asarray(amounts, _i32)
         active = amounts > 0
-        debits_n = st.a_src @ amounts.astype(_f32)           # [N], zero off-shard
-        tokens = s.tokens - self._my_slice(debits_n[None, :])[0].astype(_i32)
+        # debit senders with an exact integer segment sum over local edges
+        # (every edge lives on its source's shard); pad edges carry amount 0.
+        # The f32 twin guards the aggregate: a hub summing >2^31 would wrap
+        # the i32 debit silently (and >=2^24 already breaks the later credit
+        # matmul), so totals at the limit flag ERR_VALUE_OVERFLOW.
+        base = lax.axis_index(self.axis) * self.nl
+        src_l = jnp.clip(st.edge_src - base, 0, self.nl - 1)
+        debits = jax.ops.segment_sum(amounts, src_l, num_segments=self.nl)
+        debits_f = jax.ops.segment_sum(amounts.astype(_f32), src_l,
+                                       num_segments=self.nl)
+        tokens = s.tokens - debits
         err_local = (jnp.any(tokens < 0).astype(_i32) * ERR_TOKEN_UNDERFLOW
                      | (jnp.any(active & (s.q_len >= self.config.queue_capacity))
                         .astype(_i32) * ERR_QUEUE_OVERFLOW)
                      | (jnp.any(amounts >= F32_EXACT_LIMIT)
-                        | jnp.any(debits_n >= F32_EXACT_LIMIT)
+                        | jnp.any(debits_f >= F32_EXACT_LIMIT)
                         ).astype(_i32) * ERR_VALUE_OVERFLOW)
         s = s._replace(tokens=tokens, error=s.error | self._por(err_local))
         rts, key = self._draw_many(s.delay_key, s.time, active.shape)
@@ -463,7 +491,7 @@ class GraphShardedRunner:
                               dtype=_i32)
         popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
         elig = (s.q_len > 0) & (head_rt <= time)
-        prior = st.l_prior @ elig.astype(_f32)
+        prior = st.l_prior_c @ elig.astype(self._cnt)
         deliver = elig & (prior < 0.5)
         s = s._replace(q_head=(s.q_head + deliver) % C,
                        q_len=s.q_len - deliver.astype(_i32))
@@ -481,29 +509,33 @@ class GraphShardedRunner:
             + self._my_slice(credit_n[None, :])[0].astype(_i32),
             error=s.error | self._por(inexact * ERR_VALUE_OVERFLOW))
         rec_mask = s.recording & tok[None, :]
-        err_local = jnp.any(rec_mask & (s.rec_len >= M)).astype(_i32)
+        err_local = (jnp.any(rec_mask & (s.rec_len >= M)).astype(_i32)
+                     * ERR_RECORD_OVERFLOW
+                     | jnp.any(rec_mask & (amt > self._rec_limit)[None, :])
+                     .astype(_i32) * ERR_VALUE_OVERFLOW)
         pos = jnp.clip(s.rec_len, 0, M - 1)
         hit_m = rec_mask[:, :, None] & (
             jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
         s = s._replace(
-            rec_data=jnp.where(hit_m, amt[None, :, None], s.rec_data),
+            rec_data=jnp.where(hit_m, amt.astype(self._rec_dtype)[None, :, None],
+                               s.rec_data),
             rec_len=s.rec_len + rec_mask.astype(_i32),
-            error=s.error | self._por(err_local * ERR_RECORD_OVERFLOW),
+            error=s.error | self._por(err_local),
         )
 
         # markers: arrivals via psum, creations via all_gather
         mk = deliver & popped_marker
         mk_se = mk[None, :] & (
             popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])
-        arrivals_n = lax.psum(mk_se.astype(_f32) @ st.a_in.T,
+        arrivals_n = lax.psum(mk_se.astype(self._cnt) @ st.a_in_c.T,
                               self.axis).astype(_i32)          # [S, N]
         arrivals_l = self._my_slice(arrivals_n)                # [S, Nl]
         had_l = s.has_local
         created_l = (arrivals_l > 0) & ~had_l
         created_n = lax.all_gather(created_l, self.axis, axis=1,
                                    tiled=True)                 # [S, N]
-        created_f = created_n.astype(_f32)
-        created_dst_se = (created_f @ st.a_in) > 0.5
+        created_f = created_n.astype(self._cnt)
+        created_dst_se = (created_f @ st.a_in_c) > 0.5
         s = s._replace(
             recording=(s.recording | created_dst_se) & ~mk_se,
             frozen=jnp.where(created_l, s.tokens[None, :], s.frozen),
@@ -512,7 +544,7 @@ class GraphShardedRunner:
                           s.rem - jnp.where(had_l, arrivals_l, 0)),
             has_local=had_l | created_l,
         )
-        push_se = (created_f @ st.a_src) > 0.5
+        push_se = (created_f @ st.a_src_c) > 0.5
         payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
                                    push_se.shape)
         s = self._dense_push_multi(s, st, push_se, payload)
@@ -545,9 +577,7 @@ class GraphShardedRunner:
                         program) -> ShardedState:
         wrap_specs = self._state_specs
         s = self._unwrap(s, wrap_specs)
-        st = self._unwrap(st, ShardedTopology(
-            edge_src=P(self.axis), edge_dst=P(self.axis), a_in=P(self.axis),
-            a_src=P(self.axis), l_prior=P(self.axis), in_degree=P()))
+        st = self._unwrap(st, self._topo_specs)
         amounts, snap = program  # [T, 1, Em] shard slice, [T, J] replicated
         amounts = amounts[:, 0, :]
         program = (amounts, snap)
@@ -674,7 +704,7 @@ class GraphShardedRunner:
                 self._state_specs)
             smap = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
             self._run_batched_cache[data_axis] = jax.jit(smap(
-                partial(self._run_storm_body_batched, data_axis=data_axis),
+                self._run_storm_body_batched,
                 in_specs=(state_specs, self._topo_specs,
                           (P(None, self.axis), P())),
                 out_specs=state_specs))
@@ -684,7 +714,7 @@ class GraphShardedRunner:
             state, self.stopo_device(), (amounts_s, snap_r))
 
     def _run_storm_body_batched(self, s: ShardedState, st: ShardedTopology,
-                                program, data_axis: str) -> ShardedState:
+                                program) -> ShardedState:
         sharded = P(self.axis)
         st = self._unwrap(st, self._topo_specs)
         amounts, snap = program          # [T, 1, Em] local slice, [T, J]
@@ -752,11 +782,7 @@ class GraphShardedRunner:
 
     def stopo_device(self) -> ShardedTopology:
         if not hasattr(self, "_stopo_dev"):
-            specs = ShardedTopology(
-                edge_src=P(self.axis), edge_dst=P(self.axis),
-                a_in=P(self.axis), a_src=P(self.axis), l_prior=P(self.axis),
-                in_degree=P())
             self._stopo_dev = jax.tree_util.tree_map(
                 lambda x, sp: jax.device_put(x, NamedSharding(self.mesh, sp)),
-                self.stopo, specs)
+                self.stopo, self._topo_specs)
         return self._stopo_dev

@@ -80,17 +80,21 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 9·E·C + 8·E + 4·N + S·(1 + 10·N + E·(5 + 4·M))
+    footprint = 9·E·C + 8·E + 4·N + S·(1 + 10·N + E·(5 + rec·M))
+    with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
 
     Dominant term at bench shapes is the recorded-message buffer
     ``rec_data[S, E, M]`` (4·S·E·M) plus the ``[S, E]`` recording planes —
     size S and M to the workload, not to the worst case.
     """
+    import numpy as np
+
     n, e = num_nodes, num_edges
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
+    rec = np.dtype(cfg.record_dtype).itemsize
     queues = e * c * (1 + 4 + 4) + e * (4 + 4)          # q_* rings + head/len
     nodes = 4 * n                                       # tokens
-    snaps = s * (1 + n * (1 + 4 + 4 + 1) + e * (1 + 4 + 4 * m))
+    snaps = s * (1 + n * (1 + 4 + 4 + 1) + e * (1 + 4 + rec * m))
     scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
     return queues + nodes + snaps + scalars
 

@@ -141,6 +141,46 @@ def test_batched_error_lanes_reported():
     assert BatchedRunner.summarize(final)["error_lanes"] == 4
 
 
+def test_record_dtype_int16_halves_footprint_and_guards():
+    """SimConfig.record_dtype='int16' shrinks rec_data (the dominant HBM
+    term) and flags amounts beyond int16 range instead of truncating."""
+    from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
+
+    cfg32, cfg16 = SimConfig(), SimConfig(record_dtype="int16")
+    shrink = (instance_footprint_bytes(100, 300, cfg32)
+              - instance_footprint_bytes(100, 300, cfg16))
+    assert shrink == 2 * cfg32.max_snapshots * 300 * cfg32.max_recorded
+
+    spec = _pair(tokens=100_000)
+    runner = BatchedRunner(spec, cfg16, FixedJaxDelay(1), batch=1,
+                           scheduler="sync")
+    assert runner.init_batch().rec_data.dtype == np.int16
+    script = compile_events(runner.topo, [
+        SnapshotEvent("N2"),                      # records N1->N2
+        PassTokenEvent("N1", "N2", 40_000),       # > int16 max while recording
+        TickEvent(6)])
+    final = jax.device_get(runner.run(runner.init_batch(), script,
+                                      drain=False))
+    assert int(final.error[0]) & ERR_VALUE_OVERFLOW
+
+
+def test_record_dtype_int16_exact_path_matches_goldens():
+    """int16 records reproduce a golden case bit-exactly (amounts in the
+    fixtures are tiny)."""
+    from chandy_lamport_tpu.api import run_events_file
+    from chandy_lamport_tpu.utils.compare import assert_snapshots_equal, sort_snapshots
+    from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+    from chandy_lamport_tpu.utils.goldens import fixture_path
+
+    snaps, _ = run_events_file(fixture_path("3nodes.top"),
+                               fixture_path("3nodes-simple.events"),
+                               backend="jax",
+                               config=SimConfig(record_dtype="int16"))
+    expected = [read_snapshot_file(fixture_path("3nodes-simple.snap"))]
+    for e, a in zip(sort_snapshots(expected), sort_snapshots(snaps)):
+        assert_snapshots_equal(e, a)
+
+
 # ---------------------------------------------------------------------------
 # graph-sharded path (2 shards on the virtual CPU mesh)
 # ---------------------------------------------------------------------------

@@ -105,6 +105,43 @@ def test_script_snapshot_node_index_beyond_edge_count():
     assert shard[snap_slots].tolist() == [-1]  # snapshots carry no shard
 
 
+def test_combined_data_graph_lanes_match_single_instance():
+    """run_storm_batched on a 2-D (data x graph) mesh: with a fixed delay
+    every lane must equal the single-instance graph-sharded run."""
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        staggered_snapshots,
+        storm_program,
+    )
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh2d = Mesh(devs, ("data", "graph"))
+    spec = erdos_renyi(8, 2.5, seed=3, tokens=40)
+    cfg = SimConfig(max_snapshots=4)
+
+    single = GraphShardedRunner(spec, cfg, _graph_mesh(2), fixed_delay=2)
+    prog = storm_program(single.topo, phases=4, amount=1,
+                         snapshot_phases=staggered_snapshots(single.topo, 2))
+    ref = jax.device_get(single.run_storm(
+        single.init_state(), np.asarray(prog.amounts), np.asarray(prog.snap)))
+
+    combined = GraphShardedRunner(spec, cfg, mesh2d, fixed_delay=2)
+    batch = 4
+    final = jax.device_get(combined.run_storm_batched(
+        combined.init_batch(batch), np.asarray(prog.amounts),
+        np.asarray(prog.snap)))
+
+    for name in ("time", "tokens", "q_len", "frozen", "rec_len", "rec_data",
+                 "completed", "error", "next_sid"):
+        want = np.asarray(getattr(ref, name))
+        got = np.asarray(getattr(final, name))
+        assert got.shape == (batch,) + want.shape, name
+        for lane in range(batch):
+            np.testing.assert_array_equal(got[lane], want, err_msg=name)
+
+
 def test_sharded_state_checkpoint_roundtrip(tmp_path):
     from chandy_lamport_tpu.utils.checkpoint import load_state, save_state
 

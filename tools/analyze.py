@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Kernel cost breakdown for the bench workload (BASELINE.md §profiling).
+
+Compiles the benchmark's storm-phase pieces separately and reports XLA
+cost-analysis estimates (flops / bytes accessed) plus measured wall-clock per
+component, so the dominant op of the tick is identified even without a
+trace viewer. Use CLSIM_PLATFORM=cpu off-TPU.
+
+Usage: python tools/analyze.py [--nodes N] [--batch B] [--scheduler sync]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--attach", type=int, default=2)
+    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--snapshots", type=int, default=8)
+    p.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    p.add_argument("--repeats", type=int, default=20)
+    args = p.parse_args()
+
+    platform = os.environ.get("CLSIM_PLATFORM")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import scale_free
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})")
+
+    spec = scale_free(args.nodes, args.attach, seed=3, tokens=100)
+    cfg = SimConfig(queue_capacity=16, max_snapshots=max(8, args.snapshots),
+                    max_recorded=16)
+    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
+                           batch=args.batch, scheduler=args.scheduler)
+    topo = runner.topo
+    per = instance_footprint_bytes(topo.n, topo.e, cfg)
+    print(f"graph: N={topo.n} E={topo.e} D={topo.d}; "
+          f"footprint {per / 1e6:.3f} MB/instance, "
+          f"{per * args.batch / 1e9:.2f} GB batch")
+
+    state = runner.init_batch()
+    amounts = jnp.ones((topo.e,), jnp.int32)
+    snaps = jnp.full((args.snapshots,), -1, jnp.int32)
+    snaps_live = jnp.arange(args.snapshots, dtype=jnp.int32)
+
+    components = {
+        "tick_only": lambda s: jax.vmap(runner._tick_fn)(s),
+        "bulk_send_only": lambda s: jax.vmap(
+            lambda s: runner.kernel._bulk_send(s, amounts))(s),
+        "full_phase_no_snap": lambda s: jax.vmap(
+            runner.storm_phase, in_axes=(0, None, None))(s, amounts, snaps),
+        "full_phase_with_snaps": lambda s: jax.vmap(
+            runner.storm_phase, in_axes=(0, None, None))(s, amounts, snaps_live),
+    }
+
+    results = {}
+    for name, fn in components.items():
+        jfn = jax.jit(fn)
+        lowered = jfn.lower(state)
+        compiled = lowered.compile()
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = ca.get("flops", float("nan"))
+            bytes_acc = ca.get("bytes accessed", float("nan"))
+        except Exception as exc:  # cost analysis is backend-dependent
+            flops = bytes_acc = float("nan")
+            print(f"  ({name}: no cost analysis: {exc})")
+        out = jfn(state)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.repeats):
+            out = jfn(state)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.repeats
+        results[name] = (dt, flops, bytes_acc)
+        node_ticks = args.batch * topo.n
+        print(f"{name:24s} {dt * 1e3:8.2f} ms "
+              f"{flops / 1e9:10.2f} GF {bytes_acc / 1e9:10.2f} GB "
+              f"-> {node_ticks / dt / 1e6:8.1f}M node-ticks/s if tick-bound")
+
+    base = results["tick_only"][0]
+    send = results["bulk_send_only"][0]
+    phase = results["full_phase_no_snap"][0]
+    snapped = results["full_phase_with_snaps"][0]
+    print(f"\nbreakdown: tick {base * 1e3:.2f} ms, send {send * 1e3:.2f} ms, "
+          f"phase overhead {(phase - base - send) * 1e3:.2f} ms, "
+          f"snapshot-initiation surcharge {(snapped - phase) * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

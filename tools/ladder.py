@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Run the BASELINE.md config ladder and emit one JSON line per config.
+
+Each config is a bench invocation (same engine, same JSON contract, same
+platform-fallback ladder), so every row carries platform/device_kind and can
+never silently be a CPU number pretending to be TPU. Results append to
+``BASELINE_MEASURED.jsonl`` at the repo root and print to stdout.
+
+Configs (BASELINE.json):
+  2: 10-node ring, 1 initiator, 128 instances            — first batched run
+  3: 256-node Erdős–Rényi(avg 3), 4k instances           — single-chip scale
+  4: 1k-node scale-free, 8 initiators/instance           — the metric config
+  5: largest single-chip approximation of "8k nodes x 1M instances":
+     8k-node scale-free at the max batch that fits one chip's HBM
+     (the literal config-5 needs ~18 MB/instance x 1M = 17.8 PB — see
+     BASELINE.md for the footprint math)
+
+Usage: python tools/ladder.py [--quick] [--scheduler sync|exact|both]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench(name: str, extra: list, timeout: float) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py"),
+           "--timeout", str(timeout)] + extra
+    print(f"--- {name}: {' '.join(cmd)}", file=sys.stderr, flush=True)
+    proc = subprocess.run(cmd, stdout=subprocess.PIPE, cwd=ROOT)
+    lines = proc.stdout.decode().strip().splitlines()
+    if not lines:  # bench guarantees a line unless killed from outside
+        return {"config": name, "error": "no output", "rc": proc.returncode}
+    row = json.loads(lines[-1])
+    row["config"] = name
+    return row
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="shrink batches ~8x for a fast smoke pass")
+    p.add_argument("--scheduler", choices=["sync", "exact", "both"],
+                   default="sync")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
+    args = p.parse_args()
+
+    q = 8 if args.quick else 1
+    ladder = [
+        ("config2_ring10", ["--graph", "ring", "--nodes", "10",
+                            "--batch", str(max(128 // q, 16)),
+                            "--phases", "32", "--snapshots", "1"]),
+        ("config3_er256", ["--graph", "er", "--nodes", "256",
+                           "--batch", str(max(4096 // q, 64)),
+                           "--phases", "32", "--snapshots", "4"]),
+        ("config4_sf1k", ["--graph", "sf", "--nodes", "1024",
+                          "--batch", str(max(2048 // q, 32)),
+                          "--phases", "32", "--snapshots", "8"]),
+        ("config5_sf8k_maxbatch", ["--graph", "sf", "--nodes", "8192",
+                                   "--batch", str(max(512 // q, 8)),
+                                   "--phases", "16", "--snapshots", "8"]),
+    ]
+    schedulers = (["sync", "exact"] if args.scheduler == "both"
+                  else [args.scheduler])
+    n = 0
+    for name, extra in ladder:
+        for sched in schedulers:
+            row = bench(f"{name}_{sched}", extra + ["--scheduler", sched],
+                        args.timeout)
+            print(json.dumps(row), flush=True)
+            # append immediately so a later config's crash loses nothing
+            with open(args.out, "a") as f:
+                f.write(json.dumps(row) + "\n")
+            n += 1
+    print(f"appended {n} rows to {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

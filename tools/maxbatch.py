@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Find the largest instance batch that fits the current device.
+
+Doubles the batch until allocation/compilation fails with an out-of-memory
+error, then bisects the boundary. Each probe runs a short storm (2 phases +
+drain) so the measurement includes XLA's real working set, not just the
+state arrays. Prints one JSON line: the max batch, the footprint-model
+prediction, and their ratio (the empirical working-set factor).
+
+The 1M-instance north-star configuration is `--graph ring --nodes 10
+--max-snapshots 2` (BASELINE.md: ~7 kB/instance). Use CLSIM_PLATFORM=cpu
+off-TPU (RAM-bound there, so only the harness logic is meaningful).
+
+Usage: python tools/maxbatch.py [--nodes N] [--graph sf|ring|er] [--start B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--graph", choices=["sf", "ring", "er"], default="sf")
+    p.add_argument("--attach", type=int, default=2)
+    p.add_argument("--start", type=int, default=256)
+    p.add_argument("--limit", type=int, default=1 << 22)
+    p.add_argument("--max-snapshots", type=int, default=8)
+    p.add_argument("--record-dtype", choices=["int32", "int16"],
+                   default="int32")
+    args = p.parse_args()
+
+    platform = os.environ.get("CLSIM_PLATFORM")
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import numpy as np
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
+
+    dev = jax.devices()[0]
+    cfg = SimConfig(queue_capacity=16, max_snapshots=args.max_snapshots,
+                    max_recorded=16, record_dtype=args.record_dtype)
+    if args.graph == "ring":
+        spec = ring_topology(args.nodes, tokens=20)
+    elif args.graph == "er":
+        spec = erdos_renyi(args.nodes, 3.0, seed=3, tokens=20)
+    else:
+        spec = scale_free(args.nodes, args.attach, seed=3, tokens=20)
+
+    def probe(batch: int) -> bool:
+        """True iff a short storm at this batch completes on device."""
+        try:
+            runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=7),
+                                   batch=batch, scheduler="sync")
+            prog = storm_program(
+                runner.topo, phases=2, amount=1,
+                snapshot_phases=staggered_snapshots(runner.topo, 1))
+            t0 = time.perf_counter()
+            final = runner.run_storm(runner.init_batch(), prog)
+            jax.block_until_ready(final)
+            ok = int(np.asarray(jax.device_get(final.error)).sum()) == 0
+            log(f"batch {batch}: OK ({time.perf_counter() - t0:.1f}s, "
+                f"errors={'no' if ok else 'YES'})")
+            return ok
+        except Exception as exc:
+            msg = str(exc)
+            oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                   or "out of memory" in msg or isinstance(exc, MemoryError))
+            log(f"batch {batch}: {'OOM' if oom else 'FAIL'} "
+                f"({type(exc).__name__}: {msg[:160]})")
+            if not oom:
+                raise
+            return False
+
+    hi = args.start
+    lo = 0
+    while hi <= args.limit and probe(hi):
+        lo, hi = hi, hi * 2
+    hi = min(hi, args.limit + 1)  # --limit caps the search, not just doubling
+    if lo == 0:
+        log("start batch already OOM; lower --start")
+        lo, hi = 1, args.start
+    while hi - lo > max(lo // 16, 1):  # ~6% resolution
+        mid = (lo + hi) // 2
+        if probe(mid):
+            lo = mid
+        else:
+            hi = mid
+    e = {"ring": args.nodes, "er": int(args.nodes * 3),
+         "sf": args.nodes * (1 + args.attach)}[args.graph]
+    per = instance_footprint_bytes(args.nodes, e, cfg)
+    stats = {}
+    try:
+        m = dev.memory_stats() or {}
+        stats = {"hbm_limit_bytes": int(m.get("bytes_limit", 0))}
+    except Exception:
+        pass
+    result = {
+        "metric": "max_batch",
+        "value": lo,
+        "unit": "instances",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "graph": args.graph,
+        "nodes": args.nodes,
+        "max_snapshots": args.max_snapshots,
+        "record_dtype": args.record_dtype,
+        "footprint_bytes_per_instance": per,
+        "resident_gb_at_max": round(per * lo / 1e9, 2),
+    }
+    result.update(stats)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
