@@ -58,6 +58,14 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync",
                    help="sync = vectorized simultaneous delivery (production "
                         "path); exact = reference-semantics sequential fold")
+    p.add_argument("--capacity", type=int, default=0,
+                   help="per-edge queue slots; 0 = size to the workload "
+                        "(SimConfig.for_workload)")
+    p.add_argument("--record-dtype", choices=["int16", "int32"],
+                   default="int16",
+                   help="rec_data[S,E,M] dtype — the dominant per-instance "
+                        "HBM term; int16 halves it (amounts >= 2^15 flag "
+                        "ERR_VALUE_OVERFLOW; the bench sends amount=1)")
     p.add_argument("--target", type=float, default=10e6,
                    help="north-star node-ticks/sec/chip (BASELINE.json)")
     p.add_argument("--profile", metavar="DIR", default=None,
@@ -124,39 +132,67 @@ def run_worker(args) -> int:
         spec = erdos_renyi(args.nodes, 3.0, seed=3, tokens=tokens)
     else:
         spec = scale_free(args.nodes, args.attach, seed=3, tokens=tokens)
-    cfg = SimConfig(queue_capacity=16, max_snapshots=max(8, args.snapshots),
-                    max_recorded=16)
-    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
-                           batch=args.batch, scheduler=args.scheduler)
-    topo = runner.topo
-    log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree {topo.d}")
+
+    from chandy_lamport_tpu.core.state import ERR_QUEUE_OVERFLOW, decode_errors
     from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
 
-    per = instance_footprint_bytes(topo.n, topo.e, cfg)
-    log(f"per-instance state: {per / 1e6:.3f} MB; "
-        f"batch resident {per * args.batch / 1e9:.2f} GB")
-    prog = storm_program(
-        topo, phases=args.phases, amount=1,
-        snapshot_phases=staggered_snapshots(topo, args.snapshots, 1, 2,
-                                            max_phases=args.phases))
+    # capacity sized to the workload (the round-2 bench ran with C=16, which
+    # cannot hold the sf-1024 storm's hub-edge backlog — 4/2048 lanes fired
+    # ERR_QUEUE_OVERFLOW and the whole perf axis recorded 0.0), plus one
+    # doubled-capacity retry below as the belt to that suspender
+    cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
+                                 record_dtype=args.record_dtype)
+    if args.capacity:
+        import dataclasses
 
-    # warmup: compile + one full execution
-    t0 = time.perf_counter()
-    final = runner.run_storm(runner.init_batch(), prog)
-    jax.block_until_ready(final)
-    log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
-    summary = BatchedRunner.summarize(final)
-    log(f"summary: {summary}")
-    if summary["error_lanes"]:
-        log("ERROR: lanes with error flags — results invalid")
-        return 1
+        cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
+
+    runner = summary = None
+    for cap_try in range(2):
+        if runner is not None:  # retry: double the ring-buffer capacity
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                cfg, queue_capacity=2 * cfg.queue_capacity)
+            log(f"retrying with queue_capacity={cfg.queue_capacity}")
+        runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
+                               batch=args.batch, scheduler=args.scheduler)
+        topo = runner.topo
+        log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
+            f"{topo.d}; queue_capacity={cfg.queue_capacity}")
+        per = instance_footprint_bytes(topo.n, topo.e, cfg)
+        log(f"per-instance state: {per / 1e6:.3f} MB; "
+            f"batch resident {per * args.batch / 1e9:.2f} GB")
+        prog = storm_program(
+            topo, phases=args.phases, amount=1,
+            snapshot_phases=staggered_snapshots(topo, args.snapshots, 1, 2,
+                                                max_phases=args.phases))
+
+        # warmup: compile + one full execution (doubles as the validity check)
+        # init_batch_device: state is built ON device — shipping the multi-GB
+        # numpy state through a remote-device tunnel was the round-2
+        # bottleneck (~16 s per repeat, 30x the actual simulation time)
+        t0 = time.perf_counter()
+        final = runner.run_storm(runner.init_batch_device(), prog)
+        jax.block_until_ready(final)
+        log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
+        summary = BatchedRunner.summarize(final)
+        log(f"summary: {summary}")
+        bits = summary["error_bits"]
+        if not bits:
+            break
+        for msg in decode_errors(bits):
+            log(f"error bit: {msg}")
+        if not (bits & ERR_QUEUE_OVERFLOW) or cap_try:
+            log("ERROR: lanes with error flags — results invalid")
+            return 1
     if summary["snapshots_completed"] != summary["snapshots_started"]:
         log("ERROR: incomplete snapshots")
         return 1
 
     times, node_ticks = [], []
     for r in range(args.repeats):
-        state = runner.init_batch()
+        state = runner.init_batch_device()
         jax.block_until_ready(state)
         profiling = args.profile and r == args.repeats - 1
         if profiling:
@@ -188,6 +224,10 @@ def run_worker(args) -> int:
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
+        "phases": args.phases,
+        "repeats": args.repeats,
+        "queue_capacity": cfg.queue_capacity,
+        "record_dtype": cfg.record_dtype,
     }
     result.update(_memory_stats(dev))
     print(json.dumps(result), flush=True)

@@ -103,12 +103,12 @@ class DenseTopology:
             self.edge_table[s, fill[s]] = i  # dest-sorted within each row
             fill[s] += 1
         # per-node inbound edge ids in src-rank order (edges are (src,dst)
-        # sorted, so a filter preserves src order) — used at decode time for
-        # the sorted-src flattening of recorded messages (SURVEY.md §2.2 R9)
-        self.in_edges: List[List[int]] = [
-            [i for i, (_, d) in enumerate(edges) if d == nidx]
-            for nidx in range(self.n)
-        ]
+        # sorted; a stable sort by dst preserves src order within each dst
+        # group) — used at decode time for the sorted-src flattening of
+        # recorded messages (SURVEY.md §2.2 R9)
+        by_dst = np.argsort(self.edge_dst, kind="stable")
+        splits = np.cumsum(np.bincount(self.edge_dst, minlength=self.n))[:-1]
+        self.in_edges: List[np.ndarray] = np.split(by_dst, splits)
 
 
 class DenseState(NamedTuple):

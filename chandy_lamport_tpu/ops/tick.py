@@ -55,15 +55,32 @@ _i32 = jnp.int32
 BF16_EXACT_COUNT = 256
 
 
-def count_dtype(topo: DenseTopology):
+def count_dtype(topo: DenseTopology, override: str = "auto",
+                backend: str | None = None):
     """Dtype for 0/1 COUNT incidence matmuls (marker arrivals, created
-    masks, same-source priors): bf16 on TPU when the graph's degree bound
-    proves every output <= 256 (so bf16 is exact), else f32. Shared by
-    TickKernel and GraphShardedRunner so the numeric-exactness gate cannot
-    drift between the two paths. Token-AMOUNT reductions must never use
-    this — they stay f32/int guarded by F32_EXACT_LIMIT."""
+    masks): bf16 on TPU when the graph's degree bound proves every output
+    <= 256 (so bf16 is exact), else f32. Shared by TickKernel and
+    GraphShardedRunner so the numeric-exactness gate cannot drift between
+    the two paths. Token-AMOUNT reductions must never use this — they stay
+    f32/int guarded by F32_EXACT_LIMIT.
+
+    ``override`` (SimConfig.count_dtype): "auto" applies the gate;
+    "bfloat16" forces the fast path (rejected when the degree bound breaks
+    exactness); "float32" forces the safe path. ``backend`` defaults to the
+    live jax backend — parameterized so CI can exercise the TPU decision
+    (and the forced-bf16 numerics) on the CPU mesh."""
     degree_bound = max(int(topo.in_degree.max()) if topo.e else 0, topo.d)
-    if jax.default_backend() == "tpu" and degree_bound <= BF16_EXACT_COUNT:
+    if override == "float32":
+        return jnp.float32
+    if override == "bfloat16":
+        if degree_bound > BF16_EXACT_COUNT:
+            raise ValueError(
+                f"count_dtype=bfloat16 is not exact: degree bound "
+                f"{degree_bound} > {BF16_EXACT_COUNT}")
+        return jnp.bfloat16
+    if backend is None:
+        backend = jax.default_backend()
+    if backend == "tpu" and degree_bound <= BF16_EXACT_COUNT:
         return jnp.bfloat16
     return jnp.float32
 
@@ -102,14 +119,20 @@ class TickKernel:
         a_in[topo.edge_dst, _np.arange(e)] = 1.0   # A_in @ x_e = per-dest sum
         a_out = _np.zeros((n, e), _np.float32)
         a_out[topo.edge_src, _np.arange(e)] = 1.0  # A_out @ x_e = per-src sum
-        prior = ((topo.edge_src[None, :] == topo.edge_src[:, None])
-                 & (_np.arange(e)[None, :] < _np.arange(e)[:, None]))
+        # first outbound-edge index of each edge's source: edges are sorted
+        # by (src, dst) so edge_src is nondecreasing and searchsorted finds
+        # each source's first edge. Powers the O(E) cumsum formulation of
+        # "an earlier eligible edge of the same source exists" in _sync_tick
+        # (the previous [E, E] strict-predecessor matmul was O(E^2) HBM —
+        # ~2.4 GB of constant alone at the 8k-node ladder config).
+        self._src_first = jnp.asarray(
+            _np.searchsorted(topo.edge_src, topo.edge_src, side="left"), _i32)
         # COUNT matmuls run in bf16 on TPU for 2x MXU throughput when the
         # degree bound proves them exact (count_dtype above). Token-amount
         # matmuls always stay f32 (guarded by F32_EXACT_LIMIT), which is why
-        # _A_in exists in both dtypes; _A_out/_L_prior have no
-        # amount-carrying use, so only the count-dtype copies are kept.
-        self._cnt = count_dtype(topo)
+        # _A_in exists in both dtypes; _A_out has no amount-carrying use, so
+        # only the count-dtype copy is kept.
+        self._cnt = count_dtype(topo, cfg.count_dtype)
         # recorded amounts beyond the record dtype's range must flag, not
         # silently truncate (record_dtype shrinks rec_data[S, E, M] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
@@ -118,7 +141,6 @@ class TickKernel:
         self._A_in_c = (self._A_in if self._cnt == jnp.float32
                         else jnp.asarray(a_in, self._cnt))
         self._A_out_c = jnp.asarray(a_out, self._cnt)
-        self._L_prior_c = jnp.asarray(prior, self._cnt)
         self.tick = jax.jit(self._tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
@@ -287,15 +309,17 @@ class TickKernel:
 
         # ---- choose + pop: at most one eligible head per source (first in
         # dest order). Head reads are one-hot sums over the capacity axis;
-        # "no earlier eligible edge of the same source" is a constant-matrix
-        # matmul — zero dynamic-index gathers/scatters in the whole tick.
+        # "no earlier eligible edge of the same source" is an exclusive
+        # prefix count re-based at each source's first edge (edges are
+        # per-source contiguous) — O(E) versus the old [E, E] matmul.
         head_hit = cc == s.q_head[:, None]                        # [E, C]
         head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
         popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
         popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
         elig_e = (s.q_len > 0) & (head_rt <= time)                # [E]
-        prior = self._L_prior_c @ elig_e.astype(self._cnt)        # [E]
-        deliver_e = elig_e & (prior < 0.5)
+        elig_i = elig_e.astype(_i32)
+        before = jnp.cumsum(elig_i) - elig_i                      # exclusive
+        deliver_e = elig_e & (before == before[self._src_first])
         s = s._replace(
             q_head=(s.q_head + deliver_e) % C,
             q_len=s.q_len - deliver_e.astype(_i32),

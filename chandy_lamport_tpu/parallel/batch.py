@@ -147,12 +147,42 @@ class BatchedRunner:
 
     def init_batch(self) -> DenseState:
         """Fresh batched state: sim arrays broadcast over B, delay state
-        built per-lane."""
+        built per-lane. Host-side (numpy) — jit transfers it on first use;
+        prefer init_batch_device for timed runs."""
         single = init_state(self.topo, self.config, None)
         batched = jax.tree_util.tree_map(
             lambda x: np.broadcast_to(np.asarray(x), (self.batch,) + np.shape(x)).copy(),
             single._replace(delay_state=()))
         return batched._replace(delay_state=self._batched_delay_state())
+
+    def init_batch_device(self) -> DenseState:
+        """Fresh batched state constructed ON the device by a jitted builder
+        — no host->device transfer of the (multi-GB) state.
+
+        This matters enormously when the chip is remote: the round-2 bench
+        measured 2.2M node-ticks/s because each timed repeat shipped the
+        ~4.6 GB numpy state of init_batch through the device tunnel
+        (~16 s) inside the timed region; the tick itself runs in ~34 ms.
+        Everything in the initial state is zeros except the token balances
+        (a [N] broadcast) and the per-lane PRNG keys, so XLA materializes it
+        in microseconds.
+        """
+        if not hasattr(self, "_init_device"):
+            single = init_state(self.topo, self.config, None)
+            template = single._replace(delay_state=())
+            tokens0 = jnp.asarray(self.topo.tokens0)
+
+            def build():
+                st = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros((self.batch,) + np.shape(x),
+                                        np.asarray(x).dtype), template)
+                st = st._replace(tokens=jnp.broadcast_to(
+                    tokens0, (self.batch,) + tokens0.shape))
+                return st._replace(delay_state=self._batched_delay_state())
+
+            # cached: a fresh jit closure per call would retrace every time
+            self._init_device = jax.jit(build)
+        return self._init_device()
 
     def _batched_delay_state(self):
         if isinstance(self.delay, UniformJaxDelay):
@@ -246,11 +276,17 @@ class BatchedRunner:
 
     @staticmethod
     def summarize(state: DenseState) -> dict:
+        from chandy_lamport_tpu.utils.metrics import or_reduce
+
         return {
             "instances": int(state.time.shape[0]),
             "total_ticks": int(jnp.sum(state.time)),
             "max_time": int(jnp.max(state.time)),
             "error_lanes": int(jnp.sum(state.error != 0)),
+            # which bits fired across ALL lanes (int(max) would drop bits) —
+            # decode with core.state.decode_errors; the round-2 bench zeroed
+            # the perf axis without ever reporting WHICH flag fired
+            "error_bits": int(or_reduce(state.error)),
             "snapshots_started": int(jnp.sum(state.started)),
             "snapshots_completed": int(jnp.sum(
                 jnp.sum(state.started & (state.completed >= state.has_local.shape[-1]),

@@ -70,7 +70,10 @@ class ShardedTopology(NamedTuple):
     a_in: Any        # f32 [P, N, Em]  one-hot dst incidence (0 for pads)
     a_in_c: Any      # cnt [P, N, Em]
     a_src_c: Any     # cnt [P, N, Em]  one-hot src incidence (0 for pads)
-    l_prior_c: Any   # cnt [P, Em, Em] same-src strict predecessor
+    src_first: Any   # i32 [P, Em] local index of each edge's source's first
+    #                  edge (pads point at themselves) — O(Em) same-source
+    #                  predecessor test via prefix counts, replacing the old
+    #                  O(Em^2) strict-predecessor matrix
     in_degree: Any   # i32 [N] (replicated)
 
 
@@ -139,15 +142,15 @@ def shard_topology(topo: DenseTopology, shards: int,
         fill[p] += 1
     a_in = np.zeros((shards, n, em), np.float32)
     a_src = np.zeros((shards, n, em), np.float32)
-    l_prior = np.zeros((shards, em, em), np.float32)
+    src_first = np.tile(np.arange(em, dtype=np.int32), (shards, 1))
     for p in range(shards):
         for j in range(int(counts[p])):
             a_in[p, edge_dst[p, j], j] = 1.0
             a_src[p, edge_src[p, j], j] = 1.0
-        src_row = edge_src[p]
-        l_prior[p] = ((src_row[None, :] == src_row[:, None])
-                      & (src_row[:, None] >= 0)
-                      & (np.arange(em)[None, :] < np.arange(em)[:, None]))
+        # local edges keep global (src, dst) order, so src is nondecreasing
+        # over the real prefix; pads (tail) keep the identity default
+        row = edge_src[p, :int(counts[p])]
+        src_first[p, :int(counts[p])] = np.searchsorted(row, row, side="left")
     a_in_f = jnp.asarray(a_in)
     cnt = jnp.dtype(cnt_dtype) if cnt_dtype is not None else jnp.dtype(jnp.float32)
     return ShardedTopology(
@@ -155,7 +158,7 @@ def shard_topology(topo: DenseTopology, shards: int,
         a_in=a_in_f,
         a_in_c=a_in_f if cnt == jnp.float32 else jnp.asarray(a_in, cnt),
         a_src_c=jnp.asarray(a_src, cnt),
-        l_prior_c=jnp.asarray(l_prior, cnt),
+        src_first=jnp.asarray(src_first),
         in_degree=jnp.asarray(topo.in_degree),
     ), em
 
@@ -189,7 +192,7 @@ class GraphShardedRunner:
         # shared numeric-exactness gate with TickKernel (ops/tick.count_dtype)
         from chandy_lamport_tpu.ops.tick import count_dtype
 
-        self._cnt = count_dtype(self.topo)
+        self._cnt = count_dtype(self.topo, self.config.count_dtype)
         self._rec_dtype = jnp.dtype(self.config.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
         self.stopo, self.em = shard_topology(self.topo, self.shards,
@@ -210,7 +213,7 @@ class GraphShardedRunner:
         spec_rep = P()
         topo_specs = ShardedTopology(
             edge_src=spec_sharded, edge_dst=spec_sharded, a_in=spec_sharded,
-            a_in_c=spec_sharded, a_src_c=spec_sharded, l_prior_c=spec_sharded,
+            a_in_c=spec_sharded, a_src_c=spec_sharded, src_first=spec_sharded,
             in_degree=spec_rep)
         state_specs = ShardedState(
             time=spec_rep, tokens=spec_sharded, q_marker=spec_sharded,
@@ -491,8 +494,9 @@ class GraphShardedRunner:
                               dtype=_i32)
         popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
         elig = (s.q_len > 0) & (head_rt <= time)
-        prior = st.l_prior_c @ elig.astype(self._cnt)
-        deliver = elig & (prior < 0.5)
+        elig_i = elig.astype(_i32)
+        before = jnp.cumsum(elig_i) - elig_i
+        deliver = elig & (before == before[st.src_first])
         s = s._replace(q_head=(s.q_head + deliver) % C,
                        q_len=s.q_len - deliver.astype(_i32))
 

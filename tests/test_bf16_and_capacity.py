@@ -1,0 +1,202 @@
+"""CI coverage for two production-only code paths (round-2 VERDICT items 5/6):
+
+1. The bf16 count-matmul fast path (ops/tick.count_dtype) activates only when
+   ``jax.default_backend() == "tpu"`` — and tests/conftest.py pins every test
+   to CPU, so until now the one numeric-exactness optimization ran only in
+   production. ``SimConfig.count_dtype="bfloat16"`` forces the bf16 constants
+   through TickKernel and shard_topology on the CPU mesh, and the gate's
+   TPU-side decision is unit-tested via the ``backend`` parameter.
+
+2. ``SimConfig.for_workload`` — the capacity-sizing rule that keeps the
+   default bench/storm workloads from firing ERR_QUEUE_OVERFLOW (round 2's
+   BENCH zeroed itself because C=16 cannot hold the sf-1024 storm's hub-edge
+   backlog, sim.go:82-92 head-of-line blocking + marker bursts).
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import DenseTopology
+from chandy_lamport_tpu.core.syncsim import SyncOracle
+from chandy_lamport_tpu.models.delay import FixedDelay
+from chandy_lamport_tpu.models.workloads import (
+    scale_free,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, UniformJaxDelay
+from chandy_lamport_tpu.ops.tick import BF16_EXACT_COUNT, count_dtype
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+
+def _star(in_degree: int) -> TopologySpec:
+    """``in_degree`` spokes all pointing at one hub — the minimal graph whose
+    degree bound sits exactly at the bf16-exactness boundary."""
+    width = len(str(in_degree + 1))
+    ids = [f"N{str(i + 1).zfill(width)}" for i in range(in_degree + 1)]
+    nodes = [(nid, 10) for nid in ids]
+    links = [(nid, ids[0]) for nid in ids[1:]]
+    return TopologySpec(nodes, links)
+
+
+def test_gate_decision_by_degree_and_backend():
+    at_bound = DenseTopology(_star(BF16_EXACT_COUNT))
+    past_bound = DenseTopology(_star(BF16_EXACT_COUNT + 1))
+    # the TPU decision, exercised without TPU hardware
+    assert count_dtype(at_bound, backend="tpu") == jnp.bfloat16
+    assert count_dtype(past_bound, backend="tpu") == jnp.float32
+    # CPU always takes the safe path under "auto"
+    assert count_dtype(at_bound, backend="cpu") == jnp.float32
+    # forcing past the exactness bound is an error, not a silent wrong answer
+    with pytest.raises(ValueError, match="not exact"):
+        count_dtype(past_bound, override="bfloat16")
+    assert count_dtype(past_bound, override="float32") == jnp.float32
+
+
+def _random_program(rng, topo, phases):
+    amounts = np.zeros((phases, topo.e), np.int32)
+    floor = topo.tokens0.astype(np.int64).copy()
+    for ph in range(phases):
+        for e in rng.sample(range(topo.e), k=max(1, topo.e // 3)):
+            src = int(topo.edge_src[e])
+            if floor[src] >= 2:
+                amounts[ph, e] += 1
+                floor[src] -= 1
+    snap = np.full((phases, 1), -1, np.int32)
+    snap[1, 0] = rng.randrange(topo.n)
+    snap[3, 0] = rng.randrange(topo.n)
+    return amounts, snap
+
+
+@pytest.mark.parametrize("case", range(2))
+def test_forced_bf16_matches_oracle(case):
+    """The forced-bf16 TickKernel reproduces the integer oracle exactly —
+    the numerics the TPU gate relies on, demonstrated in CI."""
+    rng = random.Random(7100 + case)
+    spec = scale_free(rng.randrange(5, 12), 2, seed=case, tokens=60)
+    topo = DenseTopology(spec)
+    delay = rng.randrange(1, 4)
+    phases = 8
+    amounts, snap = _random_program(rng, topo, phases)
+
+    cfg = SimConfig(queue_capacity=32, max_recorded=64,
+                    count_dtype="bfloat16")
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(delay), batch=1,
+                           scheduler="sync")
+    assert runner.kernel._cnt == jnp.bfloat16
+    final = jax.device_get(
+        runner.run_storm(runner.init_batch(), (amounts, snap)))
+    lane = jax.tree_util.tree_map(lambda x: x[0], final)
+    assert int(lane.error) == 0
+
+    oracle = SyncOracle(topo, FixedDelay(delay))
+    for ph in range(phases):
+        oracle.bulk_send([int(a) for a in amounts[ph]])
+        nodes = [int(x) for x in snap[ph] if x >= 0]
+        if nodes:
+            oracle.start_snapshots(nodes)
+        oracle.tick()
+    oracle.drain_and_flush()
+
+    assert oracle.tokens == [int(t) for t in lane.tokens]
+    assert oracle.time == int(lane.time)
+    for sid in range(int(lane.next_sid)):
+        assert oracle.completed[sid] == int(lane.completed[sid]) == topo.n
+        for node in range(topo.n):
+            assert oracle.frozen[sid][node] == int(lane.frozen[sid, node])
+        for e in range(topo.e):
+            want = oracle.recorded[sid].get(e, [])
+            got = [int(lane.rec_data[sid, e, j])
+                   for j in range(int(lane.rec_len[sid, e]))]
+            assert want == got
+
+
+def test_forced_bf16_sharded_matches_f32_unsharded():
+    """shard_topology's bf16 count constants produce bit-identical state to
+    the f32 unsharded kernel (exactness, not approximate agreement)."""
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    from chandy_lamport_tpu.utils.fixtures import (
+        read_events_file,
+        read_topology_file,
+    )
+    from chandy_lamport_tpu.utils.goldens import fixture_path
+    from chandy_lamport_tpu.parallel.batch import compile_events
+
+    spec = read_topology_file(fixture_path("8nodes.top"))
+    script = read_events_file(fixture_path("8nodes-concurrent-snapshots.events"))
+    delay = 2
+
+    ref = BatchedRunner(
+        spec, SimConfig(queue_capacity=32, count_dtype="float32"),
+        FixedJaxDelay(delay), batch=1, scheduler="sync")
+    ref_final = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0],
+        jax.device_get(ref.run(ref.init_batch(),
+                               compile_events(ref.topo, script))))
+
+    gs = GraphShardedRunner(
+        spec, SimConfig(queue_capacity=32, count_dtype="bfloat16"),
+        Mesh(np.array(jax.devices()[:2]), ("graph",)), fixed_delay=delay)
+    assert gs._cnt == jnp.bfloat16
+    got = gs.gather_dense(gs.run_script(gs.init_state(), script))
+
+    assert int(got.error) == 0 == int(ref_final.error)
+    for name in ("time", "tokens", "q_len", "has_local", "frozen", "rem",
+                 "recording", "rec_len", "rec_data", "completed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(ref_final, name)), err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# capacity sizing (SimConfig.for_workload)
+# ---------------------------------------------------------------------------
+
+
+def test_for_workload_sizes_the_bench_config():
+    cfg = SimConfig.for_workload(snapshots=8)
+    # 8 markers + 1x(5+1) delay window + 8 HOL slack = 22 -> rounded to 24,
+    # the capacity measured overflow-free at the bench shape (round-2 VERDICT)
+    assert cfg.queue_capacity == 24
+    assert cfg.max_snapshots == 8
+    # floor and rounding
+    assert SimConfig.for_workload(snapshots=1, hol_slack=0).queue_capacity == 16
+    assert SimConfig.for_workload(snapshots=16).queue_capacity % 8 == 0
+
+
+def test_bench_workload_runs_clean_at_derived_capacity():
+    """The bench's own storm (scaled to CPU size) fires no overflow at the
+    derived capacity — the regression that zeroed BENCH_r02."""
+    spec = scale_free(256, 2, seed=3, tokens=26)
+    cfg = SimConfig.for_workload(snapshots=8, max_recorded=16,
+                                 record_dtype="int16")
+    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17), batch=4,
+                           scheduler="sync")
+    prog = storm_program(
+        runner.topo, phases=16, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 8, 1, 2,
+                                            max_phases=16))
+    final = runner.run_storm(runner.init_batch_device(), prog)
+    summary = BatchedRunner.summarize(final)
+    assert summary["error_bits"] == 0
+    assert summary["snapshots_completed"] == summary["snapshots_started"]
+
+
+def test_init_batch_device_matches_host_init():
+    spec = scale_free(16, 2, seed=1, tokens=20)
+    runner = BatchedRunner(spec, SimConfig(), UniformJaxDelay(seed=5),
+                           batch=3, scheduler="sync")
+    host = runner.init_batch()
+    dev = jax.device_get(runner.init_batch_device())
+    for name in host._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(host, name)), np.asarray(getattr(dev, name)),
+            err_msg=name)
