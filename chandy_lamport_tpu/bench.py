@@ -133,28 +133,30 @@ def run_worker(args) -> int:
     else:
         spec = scale_free(args.nodes, args.attach, seed=3, tokens=tokens)
 
-    from chandy_lamport_tpu.core.state import ERR_QUEUE_OVERFLOW, decode_errors
+    import dataclasses
+
+    from chandy_lamport_tpu.core.state import (
+        ERR_QUEUE_OVERFLOW,
+        ERR_RECORD_OVERFLOW,
+        decode_errors,
+    )
     from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
 
     # capacity sized to the workload (the round-2 bench ran with C=16, which
     # cannot hold the sf-1024 storm's hub-edge backlog — 4/2048 lanes fired
-    # ERR_QUEUE_OVERFLOW and the whole perf axis recorded 0.0), plus one
-    # doubled-capacity retry below as the belt to that suspender
+    # ERR_QUEUE_OVERFLOW and the whole perf axis recorded 0.0), plus
+    # doubling retries below as the belt to that suspender: queue capacity
+    # on ERR_QUEUE_OVERFLOW, recorded-message capacity on ERR_RECORD_OVERFLOW
+    # (a ring's marker circles the whole graph, recording a token per tick
+    # on every edge — small graphs legitimately need M much larger than the
+    # scale-free default)
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
                                  record_dtype=args.record_dtype)
     if args.capacity:
-        import dataclasses
-
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
 
     runner = summary = None
-    for cap_try in range(2):
-        if runner is not None:  # retry: double the ring-buffer capacity
-            import dataclasses
-
-            cfg = dataclasses.replace(
-                cfg, queue_capacity=2 * cfg.queue_capacity)
-            log(f"retrying with queue_capacity={cfg.queue_capacity}")
+    for cap_try in range(4):
         runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
                                batch=args.batch, scheduler=args.scheduler)
         topo = runner.topo
@@ -173,8 +175,21 @@ def run_worker(args) -> int:
         # numpy state through a remote-device tunnel was the round-2
         # bottleneck (~16 s per repeat, 30x the actual simulation time)
         t0 = time.perf_counter()
-        final = runner.run_storm(runner.init_batch_device(), prog)
-        jax.block_until_ready(final)
+        try:
+            final = runner.run_storm(runner.init_batch_device(), prog)
+            jax.block_until_ready(final)
+        except Exception as exc:
+            if "RESOURCE_EXHAUSTED" in str(exc) and args.batch > 1:
+                # out of HBM: halve the batch and retry (the result JSON
+                # reports the batch that actually ran, so a shrunken run is
+                # visibly labeled — tools/ladder.py marks it _CLAMPED).
+                # summary must not survive from an earlier failed try: the
+                # post-loop guard relies on it reflecting THIS runner.
+                summary = None
+                args.batch //= 2
+                log(f"device OOM; retrying with batch={args.batch}")
+                continue
+            raise
         log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
         summary = BatchedRunner.summarize(final)
         log(f"summary: {summary}")
@@ -183,9 +198,21 @@ def run_worker(args) -> int:
             break
         for msg in decode_errors(bits):
             log(f"error bit: {msg}")
-        if not (bits & ERR_QUEUE_OVERFLOW) or cap_try:
+        recoverable = ERR_QUEUE_OVERFLOW | ERR_RECORD_OVERFLOW
+        if (bits & ~recoverable) or cap_try == 3:
             log("ERROR: lanes with error flags — results invalid")
             return 1
+        if bits & ERR_QUEUE_OVERFLOW:
+            cfg = dataclasses.replace(cfg,
+                                      queue_capacity=2 * cfg.queue_capacity)
+        if bits & ERR_RECORD_OVERFLOW:
+            cfg = dataclasses.replace(cfg, max_recorded=2 * cfg.max_recorded)
+        log(f"retrying with queue_capacity={cfg.queue_capacity}, "
+            f"max_recorded={cfg.max_recorded}")
+    if summary is None or summary["error_bits"]:
+        log("ERROR: no clean warmup (repeated OOM, or error flags at the "
+            "final capacity)")
+        return 1
     if summary["snapshots_completed"] != summary["snapshots_started"]:
         log("ERROR: incomplete snapshots")
         return 1

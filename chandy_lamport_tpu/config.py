@@ -45,6 +45,14 @@ class SimConfig:
     # (forced bf16 is rejected when the degree bound breaks exactness).
     # CI exercises the forced-bf16 numerics on the CPU mesh.
     count_dtype: str = "auto"
+    # How the dense sync kernel reduces per-edge quantities to per-node
+    # sums (token credits, marker arrival counts): "matmul" uses [N, E]
+    # incidence matmuls on the MXU (fastest at small/medium graphs — 50M
+    # vs 38M node-ticks/s at the 1k-node bench — but O(N*E) FLOPs and the
+    # HLO-embedded constants break remote compilation around 8k nodes);
+    # "segsum" uses O(E) integer prefix-sum segment reductions (exact at
+    # any scale, no large constants). "auto" picks by graph size.
+    reduce_mode: str = "auto"
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
@@ -53,6 +61,8 @@ class SimConfig:
             raise ValueError("record_dtype must be 'int32' or 'int16'")
         if self.count_dtype not in ("auto", "bfloat16", "float32"):
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
+        if self.reduce_mode not in ("auto", "matmul", "segsum"):
+            raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
 
     @classmethod
     def for_workload(cls, *, snapshots: int, max_delay: int = MAX_DELAY,

@@ -102,13 +102,16 @@ class DenseTopology:
         for i, (s, _) in enumerate(edges):
             self.edge_table[s, fill[s]] = i  # dest-sorted within each row
             fill[s] += 1
-        # per-node inbound edge ids in src-rank order (edges are (src,dst)
-        # sorted; a stable sort by dst preserves src order within each dst
-        # group) — used at decode time for the sorted-src flattening of
-        # recorded messages (SURVEY.md §2.2 R9)
-        by_dst = np.argsort(self.edge_dst, kind="stable")
-        splits = np.cumsum(np.bincount(self.edge_dst, minlength=self.n))[:-1]
-        self.in_edges: List[np.ndarray] = np.split(by_dst, splits)
+        # dst-sorted edge permutation + per-node segment bounds (edges are
+        # (src,dst) sorted; a stable sort by dst preserves src order within
+        # each dst group). Shared by the decode-time sorted-src flattening
+        # of recorded messages (SURVEY.md §2.2 R9) and TickKernel's
+        # segment-sum reductions — one computation so the two cannot drift.
+        self.by_dst = np.argsort(self.edge_dst, kind="stable")
+        self.dst_bounds = np.concatenate(
+            [[0], np.cumsum(np.bincount(self.edge_dst, minlength=self.n))])
+        self.in_edges: List[np.ndarray] = np.split(
+            self.by_dst, self.dst_bounds[1:-1])
 
 
 class DenseState(NamedTuple):

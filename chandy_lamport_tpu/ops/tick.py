@@ -54,15 +54,23 @@ _i32 = jnp.int32
 # outputs provably stay within it may run in bf16 on the MXU
 BF16_EXACT_COUNT = 256
 
+# reduce_mode="auto" threshold: largest [N, E] incidence matrix worth keeping
+# as an in-HLO constant (128 MB f32). Above it, per-node reductions switch to
+# the O(E) segment-sum formulation — the 8k-node ladder config's ~800 MB
+# matrix broke remote compilation and costs O(N*E) FLOPs every tick.
+MATMUL_MAX_ELEMS = 32 * 2**20
+
 
 def count_dtype(topo: DenseTopology, override: str = "auto",
                 backend: str | None = None):
     """Dtype for 0/1 COUNT incidence matmuls (marker arrivals, created
     masks): bf16 on TPU when the graph's degree bound proves every output
-    <= 256 (so bf16 is exact), else f32. Shared by TickKernel and
-    GraphShardedRunner so the numeric-exactness gate cannot drift between
-    the two paths. Token-AMOUNT reductions must never use this — they stay
-    f32/int guarded by F32_EXACT_LIMIT.
+    <= 256 (so bf16 is exact), else f32. Used by TickKernel's
+    reduce_mode="matmul" formulation and the graph-sharded runner (whose
+    per-shard incidence matmuls ride as sharded arguments); the segsum
+    formulation needs no count gating — integer segment sums are exact.
+    Token-AMOUNT reductions must never use this — they stay f32/int guarded
+    by F32_EXACT_LIMIT.
 
     ``override`` (SimConfig.count_dtype): "auto" applies the gate;
     "bfloat16" forces the fast path (rejected when the degree bound breaks
@@ -108,17 +116,9 @@ class TickKernel:
         self._in_degree = jnp.asarray(topo.in_degree)
 
         self._rows_e = jnp.arange(topo.e, dtype=_i32)
-        # dense constants for the scatter-free sync path: incidence matrices
-        # (graph reductions become MXU matmuls — exact in f32 for counts
-        # < 2^24) and the same-source strict-predecessor matrix for the
-        # first-eligible-per-source selection
         import numpy as _np
 
         n, e = topo.n, topo.e
-        a_in = _np.zeros((n, e), _np.float32)
-        a_in[topo.edge_dst, _np.arange(e)] = 1.0   # A_in @ x_e = per-dest sum
-        a_out = _np.zeros((n, e), _np.float32)
-        a_out[topo.edge_src, _np.arange(e)] = 1.0  # A_out @ x_e = per-src sum
         # first outbound-edge index of each edge's source: edges are sorted
         # by (src, dst) so edge_src is nondecreasing and searchsorted finds
         # each source's first edge. Powers the O(E) cumsum formulation of
@@ -127,25 +127,98 @@ class TickKernel:
         # ~2.4 GB of constant alone at the 8k-node ladder config).
         self._src_first = jnp.asarray(
             _np.searchsorted(topo.edge_src, topo.edge_src, side="left"), _i32)
-        # COUNT matmuls run in bf16 on TPU for 2x MXU throughput when the
-        # degree bound proves them exact (count_dtype above). Token-amount
-        # matmuls always stay f32 (guarded by F32_EXACT_LIMIT), which is why
-        # _A_in exists in both dtypes; _A_out has no amount-carrying use, so
-        # only the count-dtype copy is kept.
-        self._cnt = count_dtype(topo, cfg.count_dtype)
+        # Per-destination reductions (token credits, marker arrival counts)
+        # have two formulations, selected by cfg.reduce_mode:
+        #   "matmul" — [N, E] one-hot incidence matmuls on the MXU. Fastest
+        #       at small/medium graphs (50M vs 38M node-ticks/s at the
+        #       1k-node bench config) but O(N*E) FLOPs, and the constants
+        #       embed into the HLO — ~1.6 GB at the 8k-node ladder config,
+        #       which broke remote compilation outright (HTTP 413).
+        #   "segsum" — prefix-sum segment sums over statically-known edge
+        #       orderings: O(E) integer VPU work, exact at any scale, no
+        #       large constants. The only choice for big graphs.
+        # "auto" picks matmul while the incidence matrix stays small.
+        # Static orderings for segsum (and the broadcasts both modes share):
+        #   by_dst: edge permutation sorting by destination (stable, so
+        #           src order is preserved within a destination group);
+        #   dst_lo/dst_hi: each node's segment bounds in that permutation;
+        #   src_lo/src_hi: each node's outbound-edge bounds (edges are
+        #           already src-sorted, no permutation needed).
+        self._by_dst = jnp.asarray(topo.by_dst, _i32)
+        self._dst_lo = jnp.asarray(topo.dst_bounds[:-1], _i32)
+        self._dst_hi = jnp.asarray(topo.dst_bounds[1:], _i32)
+        src_bounds = _np.concatenate(
+            [[0], _np.cumsum(_np.bincount(topo.edge_src, minlength=n))])
+        self._src_lo = jnp.asarray(src_bounds[:-1], _i32)
+        self._src_hi = jnp.asarray(src_bounds[1:], _i32)
+        self._edge_src_j = jnp.asarray(topo.edge_src, _i32)
+        self._edge_dst_j = jnp.asarray(topo.edge_dst, _i32)
+        self._mode = cfg.reduce_mode
+        if self._mode == "auto":
+            self._mode = "matmul" if n * e <= MATMUL_MAX_ELEMS else "segsum"
+        if self._mode == "matmul":
+            a_in = _np.zeros((n, e), _np.float32)
+            a_in[topo.edge_dst, _np.arange(e)] = 1.0
+            a_out = _np.zeros((n, e), _np.float32)
+            a_out[topo.edge_src, _np.arange(e)] = 1.0
+            # counts may run in bf16 on the MXU when the degree bound
+            # proves them exact (count_dtype); amounts stay f32 guarded by
+            # F32_EXACT_LIMIT
+            self._cnt = count_dtype(topo, cfg.count_dtype)
+            self._A_in = jnp.asarray(a_in)
+            self._A_in_c = (self._A_in if self._cnt == jnp.float32
+                            else jnp.asarray(a_in, self._cnt))
+            self._A_out_c = jnp.asarray(a_out, self._cnt)
         # recorded amounts beyond the record dtype's range must flag, not
         # silently truncate (record_dtype shrinks rec_data[S, E, M] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
-        self._A_in = jnp.asarray(a_in)
-        self._A_in_c = (self._A_in if self._cnt == jnp.float32
-                        else jnp.asarray(a_in, self._cnt))
-        self._A_out_c = jnp.asarray(a_out, self._cnt)
         self.tick = jax.jit(self._tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
         self.inject_snapshot = jax.jit(self._inject_snapshot, donate_argnums=0)
         self.drain_and_flush = jax.jit(self._drain_and_flush, donate_argnums=0)
+
+    # ---- static-order segment reductions ---------------------------------
+
+    @staticmethod
+    def _segment_sums(xs, lo, hi):
+        """[..., E] -> [..., N]: per-segment sums via an exclusive prefix sum
+        and two static-index takes (``xs`` must already be in segment order)."""
+        cs = jnp.cumsum(xs, axis=-1)
+        cs0 = jnp.concatenate([jnp.zeros_like(cs[..., :1]), cs], axis=-1)
+        return jnp.take(cs0, hi, axis=-1) - jnp.take(cs0, lo, axis=-1)
+
+    def _sum_by_dst(self, x_e, amounts: bool):
+        """Per-destination-node sums of a per-edge quantity. segsum mode is
+        integer-exact; matmul mode routes token AMOUNTS through the f32
+        incidence matrix (caller flags >= 2^24 values) and COUNTS through
+        the count-dtype copy (bf16 when the degree bound proves it exact)."""
+        if self._mode == "segsum":
+            xs = jnp.take(x_e.astype(_i32), self._by_dst, axis=-1)
+            return self._segment_sums(xs, self._dst_lo, self._dst_hi)
+        a = self._A_in if amounts else self._A_in_c
+        return (x_e.astype(a.dtype) @ a.T).astype(_i32)
+
+    def _sum_by_src(self, x_e):
+        """Per-source-node sums (edges are already src-sorted)."""
+        return self._segment_sums(x_e, self._src_lo, self._src_hi)
+
+    def _spread_dst(self, x_n):
+        """[..., N] bool -> [..., E]: broadcast a per-node flag to its
+        inbound edges. Matmul on the MXU in matmul mode (measured ~10%
+        faster per tick than the gather at the 1k-node bench shape);
+        static-index take in segsum mode (no [N, E] constants)."""
+        if self._mode == "matmul":
+            return (x_n.astype(self._cnt) @ self._A_in_c) > 0.5
+        return jnp.take(x_n, self._edge_dst_j, axis=-1)
+
+    def _spread_src(self, x_n):
+        """[..., N] bool -> [..., E]: broadcast a per-node flag to its
+        outbound edges (marker re-broadcast targets)."""
+        if self._mode == "matmul":
+            return (x_n.astype(self._cnt) @ self._A_out_c) > 0.5
+        return jnp.take(x_n, self._edge_src_j, axis=-1)
 
     # ---- queue primitives ------------------------------------------------
 
@@ -300,7 +373,6 @@ class TickKernel:
         _tick. Cost: O(E + S·E) vectorized work, no N-step sequential fold —
         this is what makes 1M-instance batches fast on TPU.
         """
-        f32 = jnp.float32
         N, E, C = self.topo.n, self.topo.e, self.cfg.queue_capacity
         S, M = self.cfg.max_snapshots, self.cfg.max_recorded
         time = s.time + 1
@@ -325,19 +397,21 @@ class TickKernel:
             q_len=s.q_len - deliver_e.astype(_i32),
         )
 
-        # ---- token deliveries: credit via incidence matmul + record into
-        # snapshots still recording at tick start (HandleToken,
+        # ---- token deliveries: credit via per-destination segment sums +
+        # record into snapshots still recording at tick start (HandleToken,
         # node.go:174-185; 'all tokens before all markers' ordering)
         tok_e = deliver_e & ~popped_marker
         amt_e = jnp.where(tok_e, popped_data, 0)                  # [E]
-        credit_f = self._A_in @ amt_e.astype(f32)                 # [N]
-        # f32 incidence reductions are exact only below 2^24; flag instead of
-        # silently violating conservation (the exact scheduler is integer)
-        inexact = (jnp.any(amt_e >= F32_EXACT_LIMIT)
-                   | jnp.any(credit_f >= F32_EXACT_LIMIT))
+        credit = self._sum_by_dst(amt_e, amounts=True)            # [N] i32
+        # integer segment sums are exact through the full i32 range; the
+        # 2^24 value-range contract is retained so a workload's validity
+        # does not depend on which scheduler (or sharded runner, whose f32
+        # incidence matmuls genuinely need it) executed it
+        toobig = (jnp.any(amt_e >= F32_EXACT_LIMIT)
+                  | jnp.any(credit >= F32_EXACT_LIMIT))
         s = s._replace(
-            tokens=s.tokens + credit_f.astype(_i32),
-            error=s.error | jnp.where(inexact, ERR_VALUE_OVERFLOW, 0).astype(_i32))
+            tokens=s.tokens + credit,
+            error=s.error | jnp.where(toobig, ERR_VALUE_OVERFLOW, 0).astype(_i32))
         rec_mask = s.recording & tok_e[None, :]                   # [S, E]
         err = s.error | jnp.where(jnp.any(rec_mask & (s.rec_len >= M)),
                                   ERR_RECORD_OVERFLOW, 0).astype(_i32)
@@ -356,18 +430,17 @@ class TickKernel:
         )
 
         # ---- marker deliveries, all snapshot slots at once (HandleMarker,
-        # node.go:149-171): arrivals per (slot, node) via incidence matmul;
-        # with k simultaneous markers for one (slot, node) all k channels are
-        # excluded from recording (CreateLocalSnapshot, node.go:58-84)
+        # node.go:149-171): arrivals per (slot, node) via per-destination
+        # segment counts; with k simultaneous markers for one (slot, node)
+        # all k channels are excluded from recording (CreateLocalSnapshot,
+        # node.go:58-84)
         mk_e = deliver_e & popped_marker                          # [E]
         mk_se = mk_e[None, :] & (
             popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, E]
-        arrivals = (mk_se.astype(self._cnt)
-                    @ self._A_in_c.T).astype(_i32)                 # [S, N]
+        arrivals = self._sum_by_dst(mk_se, amounts=False)          # [S, N]
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
-        created_f = created.astype(self._cnt)
-        created_dst_se = (created_f @ self._A_in_c) > 0.5          # [S, E]
+        created_dst_se = self._spread_dst(created)                 # [S, E]
         recording = (s.recording | created_dst_se) & ~mk_se
         rem = jnp.where(created, self._in_degree[None, :] - arrivals,
                         s.rem - jnp.where(had, arrivals, 0))
@@ -382,7 +455,7 @@ class TickKernel:
         # ---- re-broadcast from every node that just created its local
         # snapshot (node.StartSnapshot, node.go:198-212): one marker per
         # (slot, outbound edge) in one dense multi-push
-        push_se = (created_f @ self._A_out_c) > 0.5                # [S, E]
+        push_se = self._spread_src(created)                        # [S, E]
         payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
                                    push_se.shape)
         s = self._dense_push_multi(s, push_se, payload)
@@ -459,8 +532,7 @@ class TickKernel:
         sim time). Debits every sender at send time (node.go:120)."""
         amounts = jnp.asarray(amounts, _i32)
         active = amounts > 0
-        debits = jax.ops.segment_sum(amounts, self._edge_src,
-                                     num_segments=self.topo.n)
+        debits = self._sum_by_src(amounts)
         tokens = s.tokens - debits
         err = s.error | jnp.where(jnp.any(tokens < 0), ERR_TOKEN_UNDERFLOW, 0
                                   ).astype(_i32)
@@ -503,15 +575,14 @@ class TickKernel:
         (slot, node) of ``created`` [S, N] (node.go:58-84 + node.go:97-109):
         freeze balances, record all inbound channels, push one marker per
         outbound edge per created slot."""
-        created_f = created.astype(self._cnt)
-        created_dst_se = (created_f @ self._A_in_c) > 0.5          # [S, E]
+        created_dst_se = self._spread_dst(created)                 # [S, E]
         s = s._replace(
             recording=s.recording | created_dst_se,
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=jnp.where(created, self._in_degree[None, :], s.rem),
             has_local=s.has_local | created,
         )
-        push_se = (created_f @ self._A_out_c) > 0.5                # [S, E]
+        push_se = self._spread_src(created)                        # [S, E]
         payload = jnp.broadcast_to(
             jnp.arange(self.cfg.max_snapshots, dtype=_i32)[:, None],
             push_se.shape)

