@@ -105,7 +105,8 @@ def _cmd_storm(args) -> int:
                            batch=args.batch, scheduler=args.scheduler)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
-        snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2))
+        snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
+                                            max_phases=args.phases))
     final = runner.run_storm(runner.init_batch(), prog)
     jax.block_until_ready(final)
     counters = {k: int(v) for k, v in progress_counters(

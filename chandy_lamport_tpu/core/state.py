@@ -116,15 +116,39 @@ class DenseTopology:
 
 class DenseState(NamedTuple):
     """The jit carry. Shapes: N nodes, E edges, C queue slots, S snapshot
-    slots, M recorded messages per (snapshot, edge)."""
+    slots, M recorded messages per (snapshot, edge).
+
+    Channel state exists in two representations, selected by the kernel's
+    ``marker_mode`` (ops/tick.TickKernel):
+
+    - **ring** (the exact scheduler): tokens AND markers share the ring
+      buffers ``q_*`` in push order, exactly like the reference's per-link
+      FIFO (queue.go:6-28); ``m_*`` stay zero.
+    - **split** (the sync scheduler): the ring carries only tokens, and
+      markers — of which each (snapshot, edge) pair ever holds at most ONE
+      (a node broadcasts an id only on first receipt, node.go:154-156) —
+      live in the dense ``m_*[S, E]`` planes. Per-channel FIFO order
+      between the two is preserved by the monotone per-edge sequence
+      numbers ``q_seq``/``m_seq`` (allocated from ``seq_next`` at push
+      time): the channel's front is the live item with the smallest
+      sequence number, and head-of-line blocking applies to that front.
+      The win: ring CONTENT is then written only when tokens are sent
+      (per storm phase), not on every tick's marker traffic — the dense
+      per-tick [E, C] rewrite was >50% of sync-tick time on TPU.
+    """
 
     time: Any          # i32 []
     tokens: Any        # i32 [N]
-    q_marker: Any      # bool [E, C]
-    q_data: Any        # i32 [E, C]   token amount | snapshot id
+    q_marker: Any      # bool [E, C]  ring mode only (False throughout in split)
+    q_data: Any        # i32 [E, C]   token amount | snapshot id (ring mode)
     q_rtime: Any       # i32 [E, C]   delivery-eligible time
+    q_seq: Any         # i32 [E, C]   FIFO sequence number (split mode)
     q_head: Any        # i32 [E]
     q_len: Any         # i32 [E]
+    seq_next: Any      # i32 [E]      next FIFO sequence number (split mode)
+    m_pending: Any     # bool [S, E]  marker in flight (split mode)
+    m_rtime: Any       # i32 [S, E]
+    m_seq: Any         # i32 [S, E]
     next_sid: Any      # i32 []
     started: Any       # bool [S]
     has_local: Any     # bool [S, N]
@@ -150,8 +174,13 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         q_marker=np.zeros((e, c), b),
         q_data=np.zeros((e, c), i32),
         q_rtime=np.zeros((e, c), i32),
+        q_seq=np.zeros((e, c), i32),
         q_head=np.zeros(e, i32),
         q_len=np.zeros(e, i32),
+        seq_next=np.zeros(e, i32),
+        m_pending=np.zeros((s, e), b),
+        m_rtime=np.zeros((s, e), i32),
+        m_seq=np.zeros((s, e), i32),
         next_sid=np.int32(0),
         started=np.zeros(s, b),
         has_local=np.zeros((s, n), b),

@@ -134,4 +134,14 @@ def staggered_snapshots(topo: DenseTopology, count: int,
     sched = [(start_phase + k * stride, k % topo.n) for k in range(count)]
     if max_phases is not None:
         sched = [(ph % max_phases, node) for ph, node in sched]
+        # wrapping can alias two entries onto the same (phase, node); the
+        # sync scheduler's boolean init mask would silently coalesce them
+        # (and diverge from the exact scheduler, which injects a list) —
+        # dedupe here so both schedulers see the identical schedule
+        seen, unique = set(), []
+        for item in sched:
+            if item not in seen:
+                seen.add(item)
+                unique.append(item)
+        sched = unique
     return sched

@@ -105,7 +105,17 @@ class TickKernel:
                               then max_delay+1 flush ticks (test_common.go:124-137)
     """
 
-    def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay):
+    def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
+                 marker_mode: str = "ring"):
+        """marker_mode selects the channel representation (DenseState
+        docstring): "ring" = markers share the token ring buffers (required
+        by the bit-exact scheduler, whose PRNG draw order is push order);
+        "split" = markers live in [S, E] planes with FIFO order preserved
+        by sequence numbers (the sync scheduler's fast path — ring content
+        is then only written on token sends, not every tick)."""
+        if marker_mode not in ("ring", "split"):
+            raise ValueError(f"unknown marker_mode {marker_mode!r}")
+        self.marker_mode = marker_mode
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -233,9 +243,26 @@ class TickKernel:
             q_marker=s.q_marker.at[e, pos].set(is_marker),
             q_data=s.q_data.at[e, pos].set(jnp.asarray(data, _i32)),
             q_rtime=s.q_rtime.at[e, pos].set(jnp.asarray(rtime, _i32)),
+            q_seq=s.q_seq.at[e, pos].set(s.seq_next[e]),
             q_len=s.q_len.at[e].add(1),
+            seq_next=s.seq_next.at[e].add(1),
             delay_state=dstate,
             error=err,
+        )
+
+    def _push_marker(self, s: DenseState, e, sid) -> DenseState:
+        """Scalar marker enqueue, routed by marker_mode: into the ring
+        (exact scheduler) or the [S, E] pending planes (split mode). One
+        delay draw either way, so the sampler stream is mode-invariant."""
+        if self.marker_mode == "ring":
+            return self._push(s, e, True, sid)
+        rtime, dstate = self.delay.draw(s.delay_state, s.time)
+        return s._replace(
+            m_pending=s.m_pending.at[sid, e].set(True),
+            m_rtime=s.m_rtime.at[sid, e].set(jnp.asarray(rtime, _i32)),
+            m_seq=s.m_seq.at[sid, e].set(s.seq_next[e]),
+            seq_next=s.seq_next.at[e].add(1),
+            delay_state=dstate,
         )
 
     # ---- protocol handlers (node.go) ------------------------------------
@@ -262,7 +289,7 @@ class TickKernel:
         def body(k, s):
             e = self._edge_table[node, k]
             return lax.cond(e >= 0,
-                            lambda s: self._push(s, e, True, sid),
+                            lambda s: self._push_marker(s, e, sid),
                             lambda s: s, s)
         return lax.fori_loop(0, self.topo.d, body, s)
 
@@ -372,36 +399,61 @@ class TickKernel:
         consistent cuts) hold; only bit-exact golden reproduction needs
         _tick. Cost: O(E + S·E) vectorized work, no N-step sequential fold —
         this is what makes 1M-instance batches fast on TPU.
+
+        Requires marker_mode="split" (DenseState docstring): tokens live in
+        the ring, markers in the [S, E] pending planes, and the merged
+        channel's FIFO front is the live item with the smallest sequence
+        number — identical delivery schedule to the unified ring, but a
+        tick touches no [E, C] ring content (the dense per-tick rewrite was
+        >50% of tick time on TPU).
         """
+        if self.marker_mode != "split":
+            raise ValueError("_sync_tick requires marker_mode='split'")
         N, E, C = self.topo.n, self.topo.e, self.cfg.queue_capacity
         S, M = self.cfg.max_snapshots, self.cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
         cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
+        BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
 
-        # ---- choose + pop: at most one eligible head per source (first in
-        # dest order). Head reads are one-hot sums over the capacity axis;
-        # "no earlier eligible edge of the same source" is an exclusive
-        # prefix count re-based at each source's first edge (edges are
-        # per-source contiguous) — O(E) versus the old [E, E] matmul.
+        # ---- channel fronts: token head via one-hot reads over the
+        # capacity axis; marker front = the pending marker with the
+        # smallest sequence number. Whichever of the two has the smaller
+        # sequence number is the channel's FIFO front, and head-of-line
+        # blocking (queue.go semantics) applies to that front's
+        # receive time.
         head_hit = cc == s.q_head[:, None]                        # [E, C]
         head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
-        popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
-        popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
-        elig_e = (s.q_len > 0) & (head_rt <= time)                # [E]
+        head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
+        head_seq = jnp.sum(jnp.where(head_hit, s.q_seq, 0), axis=-1, dtype=_i32)
+        tok_live = s.q_len > 0
+        tok_seq = jnp.where(tok_live, head_seq, BIG)              # [E]
+        m_seq_live = jnp.where(s.m_pending, s.m_seq, BIG)         # [S, E]
+        m_front_seq = jnp.min(m_seq_live, axis=-2)                # [E]
+        m_is_front = s.m_pending & (
+            m_seq_live == jnp.expand_dims(m_front_seq, -2))       # [S, E]
+        m_front_rt = jnp.sum(jnp.where(m_is_front, s.m_rtime, 0),
+                             axis=-2, dtype=_i32)                 # [E]
+        front_is_marker = m_front_seq < tok_seq                   # [E]
+        front_rt = jnp.where(front_is_marker, m_front_rt, head_rt)
+        elig_e = (tok_live | (m_front_seq < BIG)) & (front_rt <= time)
+        # at most one delivery per source: first eligible edge in dest
+        # order, via an exclusive prefix count re-based at each source's
+        # first edge (edges are per-source contiguous) — O(E)
         elig_i = elig_e.astype(_i32)
         before = jnp.cumsum(elig_i) - elig_i                      # exclusive
         deliver_e = elig_e & (before == before[self._src_first])
+        tok_e = deliver_e & ~front_is_marker
+        mk_e = deliver_e & front_is_marker
         s = s._replace(
-            q_head=(s.q_head + deliver_e) % C,
-            q_len=s.q_len - deliver_e.astype(_i32),
+            q_head=(s.q_head + tok_e) % C,
+            q_len=s.q_len - tok_e.astype(_i32),
         )
 
         # ---- token deliveries: credit via per-destination segment sums +
         # record into snapshots still recording at tick start (HandleToken,
         # node.go:174-185; 'all tokens before all markers' ordering)
-        tok_e = deliver_e & ~popped_marker
-        amt_e = jnp.where(tok_e, popped_data, 0)                  # [E]
+        amt_e = jnp.where(tok_e, head_amt, 0)                     # [E]
         credit = self._sum_by_dst(amt_e, amounts=True)            # [N] i32
         # integer segment sums are exact through the full i32 range; the
         # 2^24 value-range contract is retained so a workload's validity
@@ -430,13 +482,13 @@ class TickKernel:
         )
 
         # ---- marker deliveries, all snapshot slots at once (HandleMarker,
-        # node.go:149-171): arrivals per (slot, node) via per-destination
-        # segment counts; with k simultaneous markers for one (slot, node)
-        # all k channels are excluded from recording (CreateLocalSnapshot,
-        # node.go:58-84)
-        mk_e = deliver_e & popped_marker                          # [E]
-        mk_se = mk_e[None, :] & (
-            popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])  # [S, E]
+        # node.go:149-171). The consumed marker per delivering edge is its
+        # front pending entry — the plane index IS the snapshot id, so
+        # mk_se needs no payload decode. With k simultaneous markers for
+        # one (slot, node) all k channels are excluded from recording
+        # (CreateLocalSnapshot, node.go:58-84).
+        mk_se = m_is_front & jnp.expand_dims(mk_e, -2)             # [S, E]
+        s = s._replace(m_pending=s.m_pending & ~mk_se)
         arrivals = self._sum_by_dst(mk_se, amounts=False)          # [S, N]
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
@@ -453,12 +505,10 @@ class TickKernel:
         )
 
         # ---- re-broadcast from every node that just created its local
-        # snapshot (node.StartSnapshot, node.go:198-212): one marker per
-        # (slot, outbound edge) in one dense multi-push
+        # snapshot (node.StartSnapshot, node.go:198-212): set the pending
+        # planes — no ring content is touched
         push_se = self._spread_src(created)                        # [S, E]
-        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
-                                   push_se.shape)
-        s = self._dense_push_multi(s, push_se, payload)
+        s = self._push_markers_split(s, push_se)
 
         # ---- finalize (node.go:165-170)
         fire = has_local & (rem == 0) & ~s.done_local
@@ -521,7 +571,9 @@ class TickKernel:
             q_marker=jnp.where(hit, is_marker, s.q_marker),
             q_data=jnp.where(hit, data[:, None], s.q_data),
             q_rtime=jnp.where(hit, jnp.asarray(rts, _i32)[:, None], s.q_rtime),
+            q_seq=jnp.where(hit, s.seq_next[:, None], s.q_seq),
             q_len=s.q_len + active.astype(_i32),
+            seq_next=s.seq_next + active.astype(_i32),
             delay_state=dstate,
             error=err,
         )
@@ -539,35 +591,28 @@ class TickKernel:
         s = s._replace(tokens=tokens, error=err)
         return self._bulk_push(s, active, False, amounts)
 
-    def _dense_push_multi(self, s: DenseState, push_se, payload_se) -> DenseState:
-        """Enqueue one message per True (slot, edge) of push_se in a single
-        dense [S, E, C] select, stacking same-edge pushes at consecutive ring
-        positions (slot order). Scatter-free; one vectorized delay draw per
-        (slot, edge) with inactive draws discarded (fast-path semantics)."""
-        C = self.cfg.queue_capacity
+    def _push_markers_split(self, s: DenseState, push_se) -> DenseState:
+        """Marker multi-push in split mode: set the per-(slot, edge) pending
+        planes — no [E, C] ring content is touched. Sequence numbers are
+        allocated in slot order for markers pushed on the same edge this
+        tick (matching the ring representation's stacking order), so the
+        merged-FIFO delivery schedule is identical. One vectorized delay
+        draw per (slot, edge) with inactive draws discarded (fast-path
+        semantics). Cannot overflow: each (snapshot, edge) pair pushes at
+        most once ever (first-receipt broadcast only, node.go:154-156) and
+        has its own dedicated plane entry."""
         S = self.cfg.max_snapshots
-        cc = jnp.arange(C, dtype=_i32)[None, :]
-        k_e = jnp.sum(push_se, axis=0, dtype=_i32)                 # [E]
-        off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se  # exclusive
-        tail = (s.q_head + s.q_len) % C
-        slot_se = (tail[None, :] + off_se) % C                     # [S, E]
         rts_se, dstate = self.delay.draw_many(s.delay_state, s.time,
                                               (S, self.topo.e))
-        hit_c = push_se[:, :, None] & (cc[None] == slot_se[:, :, None])
-        any_hit = jnp.any(hit_c, axis=0)                           # [E, C]
-        data_val = jnp.sum(jnp.where(hit_c, payload_se[:, :, None], 0),
-                           axis=0, dtype=_i32)
-        rt_val = jnp.sum(jnp.where(hit_c, rts_se[:, :, None], 0), axis=0,
-                         dtype=_i32)
-        err = s.error | jnp.where(jnp.any(s.q_len + k_e > C),
-                                  ERR_QUEUE_OVERFLOW, 0).astype(_i32)
+        off_se = jnp.cumsum(push_se, axis=-2, dtype=_i32) - push_se  # [S, E]
+        k_e = jnp.sum(push_se, axis=-2, dtype=_i32)                  # [E]
+        seq_se = jnp.expand_dims(s.seq_next, -2) + off_se
         return s._replace(
-            q_marker=jnp.where(any_hit, True, s.q_marker),
-            q_data=jnp.where(any_hit, data_val, s.q_data),
-            q_rtime=jnp.where(any_hit, rt_val, s.q_rtime),
-            q_len=s.q_len + k_e,
+            m_pending=s.m_pending | push_se,
+            m_rtime=jnp.where(push_se, jnp.asarray(rts_se, _i32), s.m_rtime),
+            m_seq=jnp.where(push_se, seq_se, s.m_seq),
+            seq_next=s.seq_next + k_e,
             delay_state=dstate,
-            error=err,
         )
 
     def _create_and_broadcast(self, s: DenseState, created) -> DenseState:
@@ -583,10 +628,7 @@ class TickKernel:
             has_local=s.has_local | created,
         )
         push_se = self._spread_src(created)                        # [S, E]
-        payload = jnp.broadcast_to(
-            jnp.arange(self.cfg.max_snapshots, dtype=_i32)[:, None],
-            push_se.shape)
-        return self._dense_push_multi(s, push_se, payload)
+        return self._push_markers_split(s, push_se)
 
     def _bulk_snapshots(self, s: DenseState, init_mask) -> DenseState:
         """Vectorized sim.StartSnapshot (sim.go:105-123) for every node in

@@ -121,15 +121,19 @@ class BatchedRunner:
 
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
-        self.kernel = TickKernel(self.topo, self.config, self.delay)
+        if scheduler not in ("exact", "sync"):
+            raise ValueError(f"unknown scheduler {scheduler!r}")
+        # sync uses the split marker representation (ring content untouched
+        # by ticks); exact needs the unified ring for push-order PRNG draws
+        self.kernel = TickKernel(
+            self.topo, self.config, self.delay,
+            marker_mode="split" if scheduler == "sync" else "ring")
         if scheduler == "exact":
             self._tick_fn = self.kernel._tick
             self._drain_fn = self.kernel._drain_and_flush
-        elif scheduler == "sync":
+        else:
             self._tick_fn = self.kernel._sync_tick
             self._drain_fn = self.kernel._sync_drain_and_flush
-        else:
-            raise ValueError(f"unknown scheduler {scheduler!r}")
         self.scheduler = scheduler
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)

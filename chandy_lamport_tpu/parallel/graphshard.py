@@ -97,15 +97,23 @@ class ShardedScript(NamedTuple):
 
 class ShardedState(NamedTuple):
     """One giant instance, sharded on the leading axis of every leaf except
-    the replicated scalars."""
+    the replicated scalars. Channel state uses the split representation
+    (core/state.DenseState docstring): rings carry tokens only; markers
+    live in the [S, Em] pending planes with FIFO order preserved by
+    per-edge sequence numbers. Everything marker/queue is local to the
+    edge's (= its source node's) shard, so the split adds no collectives."""
 
     time: Any        # i32 [] (replicated)
     tokens: Any      # i32 [P, Nl]
-    q_marker: Any    # bool [P, Em, C]
     q_data: Any      # i32 [P, Em, C]
     q_rtime: Any     # i32 [P, Em, C]
+    q_seq: Any       # i32 [P, Em, C]
     q_head: Any      # i32 [P, Em]
     q_len: Any       # i32 [P, Em]
+    seq_next: Any    # i32 [P, Em]
+    m_pending: Any   # bool [P, S, Em]
+    m_rtime: Any     # i32 [P, S, Em]
+    m_seq: Any       # i32 [P, S, Em]
     next_sid: Any    # i32 [] (replicated)
     started: Any     # bool [S] (replicated)
     has_local: Any   # bool [P, S, Nl]
@@ -216,9 +224,10 @@ class GraphShardedRunner:
             a_in_c=spec_sharded, a_src_c=spec_sharded, src_first=spec_sharded,
             in_degree=spec_rep)
         state_specs = ShardedState(
-            time=spec_rep, tokens=spec_sharded, q_marker=spec_sharded,
-            q_data=spec_sharded, q_rtime=spec_sharded, q_head=spec_sharded,
-            q_len=spec_sharded, next_sid=spec_rep, started=spec_rep,
+            time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_rtime=spec_sharded, q_seq=spec_sharded,
+            q_head=spec_sharded, q_len=spec_sharded, seq_next=spec_sharded,
+            m_pending=spec_sharded, m_rtime=spec_sharded, m_seq=spec_sharded,
+            next_sid=spec_rep, started=spec_rep,
             has_local=spec_sharded, frozen=spec_sharded, rem=spec_sharded,
             done_local=spec_sharded, recording=spec_sharded,
             rec_len=spec_sharded, rec_data=spec_sharded, completed=spec_rep,
@@ -255,11 +264,15 @@ class GraphShardedRunner:
         state = ShardedState(
             time=np.int32(0),
             tokens=tokens,
-            q_marker=np.zeros((p, em, c), np.bool_),
             q_data=np.zeros((p, em, c), np.int32),
             q_rtime=np.zeros((p, em, c), np.int32),
+            q_seq=np.zeros((p, em, c), np.int32),
             q_head=np.zeros((p, em), np.int32),
             q_len=np.zeros((p, em), np.int32),
+            seq_next=np.zeros((p, em), np.int32),
+            m_pending=np.zeros((p, s, em), np.bool_),
+            m_rtime=np.zeros((p, s, em), np.int32),
+            m_seq=np.zeros((p, s, em), np.int32),
             next_sid=np.int32(0),
             started=np.zeros(s, np.bool_),
             has_local=np.zeros((p, s, nl), np.bool_),
@@ -345,31 +358,22 @@ class GraphShardedRunner:
         d = jax.random.randint(sub, shape, 0, self.max_delay, dtype=_i32)
         return time + 1 + d, key
 
-    def _dense_push_multi(self, s: ShardedState, st: ShardedTopology,
-                          push_se, payload_se) -> ShardedState:
-        """Local twin of TickKernel._dense_push_multi (same stacking rule)."""
-        C = self.config.queue_capacity
-        cc = jnp.arange(C, dtype=_i32)[None, :]
-        k_e = jnp.sum(push_se, axis=0, dtype=_i32)
-        off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se
-        tail = (s.q_head + s.q_len) % C
-        slot_se = (tail[None, :] + off_se) % C
+    def _push_markers_split(self, s: ShardedState, st: ShardedTopology,
+                            push_se) -> ShardedState:
+        """Local twin of TickKernel._push_markers_split: set the pending
+        planes, allocating sequence numbers in slot order per edge — no
+        [Em, C] ring content is touched and no collective is needed (every
+        marker lives on its edge's shard). Cannot overflow: each
+        (snapshot, edge) pushes at most once (node.go:154-156)."""
         rts_se, key = self._draw_many(s.delay_key, s.time, push_se.shape)
-        hit_c = push_se[:, :, None] & (cc[None] == slot_se[:, :, None])
-        any_hit = jnp.any(hit_c, axis=0)
-        data_val = jnp.sum(jnp.where(hit_c, payload_se[:, :, None], 0),
-                           axis=0, dtype=_i32)
-        rt_val = jnp.sum(jnp.where(hit_c, rts_se[:, :, None], 0), axis=0,
-                         dtype=_i32)
-        err_local = jnp.any(s.q_len + k_e > C)
+        off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se
+        k_e = jnp.sum(push_se, axis=0, dtype=_i32)
         return s._replace(
-            q_marker=jnp.where(any_hit, True, s.q_marker),
-            q_data=jnp.where(any_hit, data_val, s.q_data),
-            q_rtime=jnp.where(any_hit, rt_val, s.q_rtime),
-            q_len=s.q_len + k_e,
+            m_pending=s.m_pending | push_se,
+            m_rtime=jnp.where(push_se, jnp.asarray(rts_se, _i32), s.m_rtime),
+            m_seq=jnp.where(push_se, s.seq_next[None, :] + off_se, s.m_seq),
+            seq_next=s.seq_next + k_e,
             delay_key=key,
-            error=s.error | self._por(
-                jnp.where(err_local, ERR_QUEUE_OVERFLOW, 0)),
         )
 
     def _create_and_broadcast(self, s: ShardedState, st: ShardedTopology,
@@ -389,9 +393,7 @@ class GraphShardedRunner:
             has_local=s.has_local | created_l,
         )
         push_se = (created_f @ st.a_src_c) > 0.5  # [S, Em]
-        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
-                                   push_se.shape)
-        return self._dense_push_multi(s, st, push_se, payload)
+        return self._push_markers_split(s, st, push_se)
 
     def _bulk_send(self, s: ShardedState, st: ShardedTopology,
                    amounts) -> ShardedState:
@@ -422,10 +424,11 @@ class GraphShardedRunner:
         pos = (s.q_head + s.q_len) % C
         hit = active[:, None] & (cc == pos[:, None])
         return s._replace(
-            q_marker=jnp.where(hit, False, s.q_marker),
             q_data=jnp.where(hit, amounts[:, None], s.q_data),
             q_rtime=jnp.where(hit, rts[:, None], s.q_rtime),
+            q_seq=jnp.where(hit, s.seq_next[:, None], s.q_seq),
             q_len=s.q_len + active.astype(_i32),
+            seq_next=s.seq_next + active.astype(_i32),
             delay_key=key,
         )
 
@@ -471,11 +474,13 @@ class GraphShardedRunner:
 
         return s._replace(
             tokens=s.tokens.at[src_l].add(-amt_i * a),
-            q_marker=s.q_marker.at[e, pos].set(sel(s.q_marker[e, pos], False)),
             q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i)),
             q_rtime=s.q_rtime.at[e, pos].set(
                 sel(s.q_rtime[e, pos], jnp.asarray(rt, _i32))),
+            q_seq=s.q_seq.at[e, pos].set(
+                sel(s.q_seq[e, pos], s.seq_next[e])),
             q_len=s.q_len.at[e].add(a),
+            seq_next=s.seq_next.at[e].add(a),
             delay_key=key,
             error=s.error | self._por(err_local),
         )
@@ -488,21 +493,38 @@ class GraphShardedRunner:
         s = s._replace(time=time)
         cc = jnp.arange(C, dtype=_i32)[None, :]
 
+        # channel fronts under the split representation (mirrors
+        # TickKernel._sync_tick): token head via one-hot reads, marker
+        # front = min-seq pending plane entry; the merged FIFO's front is
+        # whichever has the smaller sequence number. All per-edge state is
+        # local to this shard — no collective in the front selection.
+        BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
         head_hit = cc == s.q_head[:, None]
         head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
-        popped_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
-                              dtype=_i32)
-        popped_marker = jnp.any(head_hit & s.q_marker, axis=-1)
-        elig = (s.q_len > 0) & (head_rt <= time)
+        head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
+                           dtype=_i32)
+        head_seq = jnp.sum(jnp.where(head_hit, s.q_seq, 0), axis=-1,
+                           dtype=_i32)
+        tok_live = s.q_len > 0
+        tok_seq = jnp.where(tok_live, head_seq, BIG)
+        m_seq_live = jnp.where(s.m_pending, s.m_seq, BIG)        # [S, Em]
+        m_front_seq = jnp.min(m_seq_live, axis=0)                # [Em]
+        m_is_front = s.m_pending & (m_seq_live == m_front_seq[None, :])
+        m_front_rt = jnp.sum(jnp.where(m_is_front, s.m_rtime, 0),
+                             axis=0, dtype=_i32)
+        front_is_marker = m_front_seq < tok_seq
+        front_rt = jnp.where(front_is_marker, m_front_rt, head_rt)
+        elig = (tok_live | (m_front_seq < BIG)) & (front_rt <= time)
         elig_i = elig.astype(_i32)
         before = jnp.cumsum(elig_i) - elig_i
         deliver = elig & (before == before[st.src_first])
-        s = s._replace(q_head=(s.q_head + deliver) % C,
-                       q_len=s.q_len - deliver.astype(_i32))
+        tok = deliver & ~front_is_marker
+        mk = deliver & front_is_marker
+        s = s._replace(q_head=(s.q_head + tok) % C,
+                       q_len=s.q_len - tok.astype(_i32))
 
         # tokens: cross-shard credit via psum of per-node partials
-        tok = deliver & ~popped_marker
-        amt = jnp.where(tok, popped_data, 0)
+        amt = jnp.where(tok, head_amt, 0)
         credit_n = lax.psum(st.a_in @ amt.astype(_f32), self.axis)  # [N]
         # f32 reductions exact only below 2^24 (same guard as the unsharded
         # sync tick); psum makes the threshold check see the global credit
@@ -527,10 +549,11 @@ class GraphShardedRunner:
             error=s.error | self._por(err_local),
         )
 
-        # markers: arrivals via psum, creations via all_gather
-        mk = deliver & popped_marker
-        mk_se = mk[None, :] & (
-            popped_data[None, :] == jnp.arange(S, dtype=_i32)[:, None])
+        # markers: the consumed marker per delivering edge is its front
+        # pending entry (plane index == snapshot id); arrivals via psum,
+        # creations via all_gather
+        mk_se = m_is_front & mk[None, :]
+        s = s._replace(m_pending=s.m_pending & ~mk_se)
         arrivals_n = lax.psum(mk_se.astype(self._cnt) @ st.a_in_c.T,
                               self.axis).astype(_i32)          # [S, N]
         arrivals_l = self._my_slice(arrivals_n)                # [S, Nl]
@@ -549,9 +572,7 @@ class GraphShardedRunner:
             has_local=had_l | created_l,
         )
         push_se = (created_f @ st.a_src_c) > 0.5
-        payload = jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
-                                   push_se.shape)
-        s = self._dense_push_multi(s, st, push_se, payload)
+        s = self._push_markers_split(s, st, push_se)
 
         fire = s.has_local & (s.rem == 0) & ~s.done_local
         fired = lax.psum(jnp.sum(fire, axis=-1, dtype=_i32), self.axis)  # [S]
@@ -765,11 +786,19 @@ class GraphShardedRunner:
         return DenseState(
             time=np.asarray(h.time),
             tokens=nodes(h.tokens),
-            q_marker=edges(h.q_marker),
+            # the sharded runner is split-only: the ring never holds markers,
+            # so the DenseState view's ring marker plane is all-False
+            q_marker=np.zeros((self.topo.e, self.config.queue_capacity),
+                              np.bool_),
             q_data=edges(h.q_data),
             q_rtime=edges(h.q_rtime),
+            q_seq=edges(h.q_seq),
             q_head=edges(h.q_head),
             q_len=edges(h.q_len),
+            seq_next=edges(h.seq_next),
+            m_pending=slot_edges(h.m_pending),
+            m_rtime=slot_edges(h.m_rtime),
+            m_seq=slot_edges(h.m_seq),
             next_sid=np.asarray(h.next_sid),
             started=np.asarray(h.started),
             has_local=nodes(h.has_local),

@@ -63,7 +63,9 @@ def progress_counters(state: DenseState, cfg: SimConfig,
     return {
         "time_total": jnp.sum(state.time),
         "time_max": jnp.max(state.time),
-        "queued_messages": jnp.sum(state.q_len),
+        # ring tokens + split-mode pending markers (which occupy no ring
+        # slots) — either term is zero in the mode that doesn't use it
+        "queued_messages": jnp.sum(state.q_len) + jnp.sum(state.m_pending),
         "snapshots_started": jnp.sum(started),
         "snapshots_completed": jnp.sum(complete),
         "snapshots_pending": jnp.sum(started & ~complete),
@@ -80,21 +82,26 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 9·E·C + 8·E + 4·N + S·(1 + 10·N + E·(5 + rec·M))
+    footprint = 13·E·C + 12·E + 4·N + S·(1 + 10·N + E·(14 + rec·M))
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
 
     Dominant term at bench shapes is the recorded-message buffer
-    ``rec_data[S, E, M]`` (4·S·E·M) plus the ``[S, E]`` recording planes —
-    size S and M to the workload, not to the worst case.
+    ``rec_data[S, E, M]`` (rec·S·E·M) plus the ``[S, E]`` recording and
+    split-marker planes — size S and M to the workload, not to the worst
+    case.
     """
     import numpy as np
 
     n, e = num_nodes, num_edges
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
     rec = np.dtype(cfg.record_dtype).itemsize
-    queues = e * c * (1 + 4 + 4) + e * (4 + 4)          # q_* rings + head/len
+    # q_* rings (marker/data/rtime/seq) + head/len/seq_next
+    queues = e * c * (1 + 4 + 4 + 4) + e * (4 + 4 + 4)
     nodes = 4 * n                                       # tokens
-    snaps = s * (1 + n * (1 + 4 + 4 + 1) + e * (1 + 4 + rec * m))
+    # per slot: started + [S,N] planes + recording/rec_len/rec_data +
+    # split-marker planes m_pending/m_rtime/m_seq
+    snaps = s * (1 + n * (1 + 4 + 4 + 1)
+                 + e * (1 + 4 + rec * m) + e * (1 + 4 + 4))
     scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
     return queues + nodes + snaps + scalars
 

@@ -78,12 +78,16 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
     counts = [sum(1 for i in range(gs.topo.e)
                   if gs.topo.edge_src[i] // gs.nl == p)
               for p in range(shards)]
-    for name in ("q_marker", "q_data", "q_rtime", "q_head", "q_len"):
+    # split representation: rings never hold markers (the sharded state has
+    # no marker plane at all; the dense one must be all-False)
+    assert not np.asarray(ref_final.q_marker).any()
+    for name in ("q_data", "q_rtime", "q_seq", "q_head", "q_len", "seq_next"):
         parts = [getattr(final, name)[p][:counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=0)
         want = getattr(ref_final, name)[perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    for name in ("recording", "rec_len", "rec_data"):
+    for name in ("recording", "rec_len", "rec_data", "m_pending", "m_rtime",
+                 "m_seq"):
         parts = [getattr(final, name)[p][:, :counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=1)
         want = getattr(ref_final, name)[:, perm]
