@@ -66,6 +66,9 @@ def _parser() -> argparse.ArgumentParser:
                    help="rec_data[S,E,M] dtype — the dominant per-instance "
                         "HBM term; int16 halves it (amounts >= 2^15 flag "
                         "ERR_VALUE_OVERFLOW; the bench sends amount=1)")
+    p.add_argument("--pallas-rec", action="store_true",
+                   help="use the Pallas block-skipping kernel for the "
+                        "recorded-message append (ops/pallas_rec.py)")
     p.add_argument("--target", type=float, default=10e6,
                    help="north-star node-ticks/sec/chip (BASELINE.json)")
     p.add_argument("--profile", metavar="DIR", default=None,
@@ -150,8 +153,12 @@ def run_worker(args) -> int:
     # (a ring's marker circles the whole graph, recording a token per tick
     # on every edge — small graphs legitimately need M much larger than the
     # scale-free default)
+    if args.pallas_rec and args.scheduler != "sync":
+        log("ERROR: --pallas-rec only affects the sync scheduler")
+        return 1
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
-                                 record_dtype=args.record_dtype)
+                                 record_dtype=args.record_dtype,
+                                 use_pallas_rec=args.pallas_rec)
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
 
@@ -255,6 +262,7 @@ def run_worker(args) -> int:
         "repeats": args.repeats,
         "queue_capacity": cfg.queue_capacity,
         "record_dtype": cfg.record_dtype,
+        "use_pallas_rec": cfg.use_pallas_rec,
     }
     result.update(_memory_stats(dev))
     print(json.dumps(result), flush=True)

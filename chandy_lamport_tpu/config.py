@@ -53,6 +53,11 @@ class SimConfig:
     # "segsum" uses O(E) integer prefix-sum segment reductions (exact at
     # any scale, no large constants). "auto" picks by graph size.
     reduce_mode: str = "auto"
+    # Use the Pallas block-skipping kernel (ops/pallas_rec.py) for the
+    # recorded-message append in the sync tick: clean [tile, M] blocks of
+    # rec_data move zero HBM bytes instead of being rewritten every tick.
+    # Opt-in: TPU (compiled) or any backend (interpret mode, used by CI).
+    use_pallas_rec: bool = False
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:

@@ -1,0 +1,136 @@
+"""Pallas TPU kernel for the recorded-message append (SURVEY.md §7.2.7).
+
+The sync tick appends at most one amount per (snapshot, edge) column of
+``rec_data[S, E, M]`` per tick (HandleToken, reference node.go:174-185). The
+XLA formulation is a dense masked select that rewrites the ENTIRE buffer
+every tick — measured 5.3 ms/tick at the bench shape (17% of tick time,
+BASELINE.md op profile) even though only ~N of the S*E columns can change.
+
+XLA cannot skip data-dependently; Pallas can. This kernel:
+
+  - tiles rec_data into [TILE_E, M] blocks that stay in HBM (no automatic
+    block pipeline — the whole point is NOT moving clean blocks);
+  - receives a scalar-prefetched per-(slot, tile) dirty bitmap, computed
+    by the caller as a cheap [S, nTiles] any-reduction of the record mask;
+  - aliases the input buffer to the output (in-place), so a clean block's
+    grid step executes NOTHING — zero HBM traffic;
+  - for dirty blocks, DMAs the block (and its [TILE_E] metadata slices)
+    into VMEM, applies the one-hot append, and DMAs the block back.
+
+A ragged edge count is handled by OVERLAPPING the last tile (start clamped
+to E - TILE_E): the append is a pure idempotent assignment, so columns
+covered by two tiles converge to the same value.
+
+Traffic collapses from S*E*M*itemsize per tick to (dirty tiles) x block
+size — at the bench shape the dirty column fraction is ~N/(S*E) ~ 4%.
+
+Exposed via ``SimConfig.use_pallas_rec`` (opt-in; TPU or interpret mode).
+Numerics validated against the jnp formulation in tests/test_pallas_rec.py
+on the CPU mesh with interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_i32 = jnp.int32
+
+
+def _kernel(tile_e, e_dim, dirty_ref, pos_ref, mask_ref, amt_ref,
+            rec_in_ref, rec_out_ref):
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    m = rec_in_ref.shape[-1]
+    start = jnp.minimum(t * tile_e, e_dim - tile_e)
+
+    @pl.when(dirty_ref[s, t] != 0)
+    def _():
+        def inner(rec_v, pos_v, mask_v, amt_v, sem):
+            pltpu.make_async_copy(
+                rec_in_ref.at[s, pl.ds(start, tile_e), :], rec_v, sem).start()
+            pltpu.make_async_copy(
+                rec_in_ref.at[s, pl.ds(start, tile_e), :], rec_v, sem).wait()
+            for src, dst in ((pos_ref.at[s, pl.ds(start, tile_e)], pos_v),
+                             (mask_ref.at[s, pl.ds(start, tile_e)], mask_v),
+                             (amt_ref.at[0, pl.ds(start, tile_e)], amt_v)):
+                pltpu.make_async_copy(src, dst, sem).start()
+                pltpu.make_async_copy(src, dst, sem).wait()
+            m_idx = jax.lax.broadcasted_iota(_i32, (tile_e, m), 1)
+            hit = (mask_v[:] != 0)[:, None] & (m_idx == pos_v[:][:, None])
+            rec_v[:] = jnp.where(
+                hit, amt_v[:][:, None].astype(rec_v.dtype), rec_v[:])
+            out = rec_out_ref.at[s, pl.ds(start, tile_e), :]
+            pltpu.make_async_copy(rec_v, out, sem).start()
+            pltpu.make_async_copy(rec_v, out, sem).wait()
+
+        pl.run_scoped(
+            inner,
+            pltpu.VMEM((tile_e, m), rec_in_ref.dtype),
+            pltpu.VMEM((tile_e,), _i32),
+            pltpu.VMEM((tile_e,), _i32),
+            pltpu.VMEM((tile_e,), _i32),
+            pltpu.SemaphoreType.DMA(()),
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("tile_e", "interpret"),
+                   donate_argnums=0)
+def rec_append(rec_data, rec_len, rec_mask, amt_e, *, tile_e: int = 512,
+               interpret: bool = False):
+    """In-place-append ``amt_e[e]`` at ``rec_data[s, e, rec_len[s, e]]`` for
+    every (s, e) with ``rec_mask[s, e]`` — skipping clean [tile_e, M] blocks
+    entirely. The caller advances rec_len and raises the overflow flags (the
+    kernel clips like the jnp path, so flagged-overflow states stay
+    bit-identical to it).
+
+    Shapes: rec_data [S, E, M], rec_len/rec_mask [S, E], amt_e [E];
+    E >= tile_e (shrink tile_e for tiny graphs).
+    """
+    s_dim, e_dim, m_dim = rec_data.shape
+    if e_dim < tile_e:
+        raise ValueError(f"E={e_dim} < tile_e={tile_e}; shrink tile_e")
+    n_tiles = pl.cdiv(e_dim, tile_e)
+    pos = jnp.clip(rec_len, 0, m_dim - 1).astype(_i32)
+    mask_i = rec_mask.astype(_i32)
+    pad = n_tiles * tile_e - e_dim
+    dirty = jnp.any(
+        jnp.pad(rec_mask, ((0, 0), (0, pad))).reshape(
+            s_dim, n_tiles, tile_e), axis=-1).astype(_i32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_dim, n_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # pos (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),  # mask
+            pl.BlockSpec(memory_space=pl.ANY),  # amt [1, E]
+            pl.BlockSpec(memory_space=pl.ANY),  # rec_data (HBM, aliased)
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, tile_e, e_dim),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(rec_data.shape, rec_data.dtype),
+        # operand indices include the scalar-prefetch arg: dirty=0, pos=1,
+        # mask=2, amt=3, rec_data=4 — alias rec_data to the single output
+        input_output_aliases={4: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(dirty, pos, mask_i, amt_e.astype(_i32)[None, :], rec_data)
+
+
+def rec_append_reference(rec_data, rec_len, rec_mask, amt_e):
+    """The jnp formulation (what TickKernel._sync_tick inlines) — the
+    numeric ground truth for the kernel tests."""
+    m = rec_data.shape[-1]
+    pos = jnp.clip(rec_len, 0, m - 1)
+    hit = rec_mask[:, :, None] & (
+        jnp.arange(m, dtype=_i32)[None, None, :] == pos[:, :, None])
+    return jnp.where(hit, amt_e.astype(rec_data.dtype)[None, :, None],
+                     rec_data)

@@ -470,13 +470,25 @@ class TickKernel:
         err = err | jnp.where(
             jnp.any(rec_mask & (amt_e > self._rec_limit)[None, :]),
             ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        pos = jnp.clip(s.rec_len, 0, M - 1)
-        hit_m = rec_mask[:, :, None] & (
-            jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
+        if self.cfg.use_pallas_rec:
+            # block-skipping Pallas append: clean [tile, M] blocks of
+            # rec_data move zero HBM bytes (ops/pallas_rec.py); compiled on
+            # TPU, interpreted elsewhere (CI runs the interpret path)
+            from chandy_lamport_tpu.ops import pallas_rec
+
+            rec_data = pallas_rec.rec_append(
+                s.rec_data, s.rec_len, rec_mask, amt_e,
+                tile_e=min(512, E),
+                interpret=jax.default_backend() != "tpu")
+        else:
+            # the same formulation the kernel tests use as ground truth —
+            # one definition so the two paths cannot drift
+            from chandy_lamport_tpu.ops.pallas_rec import rec_append_reference
+
+            rec_data = rec_append_reference(s.rec_data, s.rec_len, rec_mask,
+                                            amt_e)
         s = s._replace(
-            rec_data=jnp.where(hit_m,
-                               amt_e.astype(self._rec_dtype)[None, :, None],
-                               s.rec_data),
+            rec_data=rec_data,
             rec_len=s.rec_len + rec_mask.astype(_i32),
             error=err,
         )

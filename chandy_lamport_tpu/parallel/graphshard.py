@@ -539,12 +539,11 @@ class GraphShardedRunner:
                      * ERR_RECORD_OVERFLOW
                      | jnp.any(rec_mask & (amt > self._rec_limit)[None, :])
                      .astype(_i32) * ERR_VALUE_OVERFLOW)
-        pos = jnp.clip(s.rec_len, 0, M - 1)
-        hit_m = rec_mask[:, :, None] & (
-            jnp.arange(M, dtype=_i32)[None, None, :] == pos[:, :, None])
+        from chandy_lamport_tpu.ops.pallas_rec import rec_append_reference
+
         s = s._replace(
-            rec_data=jnp.where(hit_m, amt.astype(self._rec_dtype)[None, :, None],
-                               s.rec_data),
+            rec_data=rec_append_reference(s.rec_data, s.rec_len, rec_mask,
+                                          amt),
             rec_len=s.rec_len + rec_mask.astype(_i32),
             error=s.error | self._por(err_local),
         )

@@ -79,7 +79,9 @@ def main() -> None:
                 runner.topo, phases=2, amount=1,
                 snapshot_phases=staggered_snapshots(runner.topo, 1))
             t0 = time.perf_counter()
-            final = runner.run_storm(runner.init_batch(), prog)
+            # device-side init: a 1M-instance host state would take minutes
+            # to build and ship through the remote tunnel
+            final = runner.run_storm(runner.init_batch_device(), prog)
             jax.block_until_ready(final)
             ok = int(np.asarray(jax.device_get(final.error)).sum()) == 0
             log(f"batch {batch}: OK ({time.perf_counter() - t0:.1f}s, "
