@@ -98,9 +98,15 @@ def _cmd_storm(args) -> int:
            "sf": lambda: scale_free(args.nodes, 2, args.seed,
                                     tokens=args.phases + 10)}[args.graph]
     spec = gen()
-    cfg = SimConfig(queue_capacity=args.queue_capacity,
-                    max_snapshots=max(8, args.snapshots),
-                    max_recorded=args.max_recorded)
+    if args.pallas_rec and args.scheduler != "sync":
+        print("--pallas-rec only affects the sync scheduler", file=sys.stderr)
+        return 2
+    cfg = SimConfig.for_workload(
+        snapshots=args.snapshots, max_recorded=args.max_recorded,
+        record_dtype=args.record_dtype, reduce_mode=args.reduce_mode,
+        use_pallas_rec=args.pallas_rec,
+        **({"queue_capacity": args.queue_capacity}
+           if args.queue_capacity else {}))
     runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=args.seed),
                            batch=args.batch, scheduler=args.scheduler)
     prog = storm_program(
@@ -162,8 +168,17 @@ def main(argv=None) -> int:
     ps.add_argument("--snapshots", type=int, default=8)
     ps.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     ps.add_argument("--seed", type=int, default=0)
-    ps.add_argument("--queue-capacity", type=int, default=16)
+    ps.add_argument("--queue-capacity", type=int, default=0,
+                    help="per-edge ring slots; 0 = size to the workload "
+                         "(SimConfig.for_workload)")
     ps.add_argument("--max-recorded", type=int, default=16)
+    ps.add_argument("--record-dtype", choices=["int32", "int16"],
+                    default="int32")
+    ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
+                    default="auto")
+    ps.add_argument("--pallas-rec", action="store_true",
+                    help="Pallas block-skipping recorded-message append "
+                         "(sync scheduler only)")
     ps.add_argument("--checkpoint", help="save final state to this .npz")
     ps.set_defaults(fn=_cmd_storm)
 

@@ -188,6 +188,12 @@ class GraphShardedRunner:
         unsharded kernel (counter-based streams differ by construction)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
+        if self.config.use_pallas_rec:
+            # not wired through shard_map yet — reject rather than silently
+            # measuring the dense jnp append under a config that claims
+            # otherwise (the dense BatchedRunner honors the flag)
+            raise ValueError(
+                "use_pallas_rec is not supported by GraphShardedRunner")
         self.mesh = mesh
         self.axis = axis
         self.shards = mesh.shape[axis]

@@ -177,6 +177,13 @@ def test_for_workload_sizes_the_bench_config():
     # floor and rounding
     assert SimConfig.for_workload(snapshots=1, hol_slack=0).queue_capacity == 16
     assert SimConfig.for_workload(snapshots=16).queue_capacity % 8 == 0
+    # an explicit capacity override beats the derived size (the CLI's
+    # --queue-capacity path)
+    assert SimConfig.for_workload(
+        snapshots=8, queue_capacity=48).queue_capacity == 48
+    # other overrides pass through
+    assert SimConfig.for_workload(
+        snapshots=2, use_pallas_rec=True).use_pallas_rec
 
 
 def test_bench_workload_runs_clean_at_derived_capacity():

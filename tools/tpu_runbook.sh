@@ -1,0 +1,42 @@
+#!/bin/bash
+# One-shot TPU measurement pipeline for a round: run when the device tunnel
+# is up. Appends everything to /tmp/runbook_out/ and BASELINE_MEASURED.jsonl.
+#
+#   1. headline bench A/B: jnp rec path vs --pallas-rec
+#   2. op-level tick profile (tools/profile_tick.py)
+#   3. the BASELINE.md config ladder, sync + exact schedulers
+#   4. max-batch probe at the 1M-instance north-star config (ring-10)
+#
+# Usage: bash tools/tpu_runbook.sh [outdir]
+set -u
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-/tmp/runbook_out}"
+mkdir -p "$OUT"
+cd "$ROOT"
+
+echo "=== 1a. bench (jnp rec path) ==="
+timeout 900 python bench.py --repeats 2 2>"$OUT/bench_plain.err" \
+    | tee "$OUT/bench_plain.json"
+tail -5 "$OUT/bench_plain.err"
+
+echo "=== 1b. bench (--pallas-rec) ==="
+timeout 900 python bench.py --repeats 2 --pallas-rec 2>"$OUT/bench_pallas.err" \
+    | tee "$OUT/bench_pallas.json"
+tail -5 "$OUT/bench_pallas.err"
+
+echo "=== 2. tick profile ==="
+timeout 900 python tools/profile_tick.py --out "$OUT/tickprof" \
+    > "$OUT/profile.txt" 2>"$OUT/profile.err"
+cat "$OUT/profile.txt"
+
+echo "=== 3. ladder (sync + exact) ==="
+timeout 5400 python tools/ladder.py --scheduler both --timeout 600 \
+    > "$OUT/ladder.jsonl" 2>"$OUT/ladder.err"
+cat "$OUT/ladder.jsonl"
+
+echo "=== 4. maxbatch (ring-10 north-star config) ==="
+timeout 1800 python tools/maxbatch.py --graph ring --nodes 10 \
+    --max-snapshots 2 --start 4096 > "$OUT/maxbatch.json" 2>"$OUT/maxbatch.err"
+cat "$OUT/maxbatch.json"
+
+echo "=== runbook done; artifacts in $OUT ==="
