@@ -97,10 +97,10 @@ def _cmd_storm(args) -> int:
                                      tokens=args.phases + 10),
            "sf": lambda: scale_free(args.nodes, 2, args.seed,
                                     tokens=args.phases + 10)}[args.graph]
-    spec = gen()
     if args.pallas_rec and args.scheduler != "sync":
         print("--pallas-rec only affects the sync scheduler", file=sys.stderr)
         return 2
+    spec = gen()
     cfg = SimConfig.for_workload(
         snapshots=args.snapshots, max_recorded=args.max_recorded,
         record_dtype=args.record_dtype, reduce_mode=args.reduce_mode,

@@ -120,8 +120,8 @@ class TickKernel:
         self.cfg = cfg
         self.delay = delay
         # static topology constants baked into the traces
-        self._edge_src = jnp.asarray(topo.edge_src)
-        self._edge_dst = jnp.asarray(topo.edge_dst)
+        self._edge_src = jnp.asarray(topo.edge_src, _i32)
+        self._edge_dst = jnp.asarray(topo.edge_dst, _i32)
         self._edge_table = jnp.asarray(topo.edge_table)
         self._in_degree = jnp.asarray(topo.in_degree)
 
@@ -161,8 +161,6 @@ class TickKernel:
             [[0], _np.cumsum(_np.bincount(topo.edge_src, minlength=n))])
         self._src_lo = jnp.asarray(src_bounds[:-1], _i32)
         self._src_hi = jnp.asarray(src_bounds[1:], _i32)
-        self._edge_src_j = jnp.asarray(topo.edge_src, _i32)
-        self._edge_dst_j = jnp.asarray(topo.edge_dst, _i32)
         self._mode = cfg.reduce_mode
         if self._mode == "auto":
             self._mode = "matmul" if n * e <= MATMUL_MAX_ELEMS else "segsum"
@@ -221,14 +219,14 @@ class TickKernel:
         static-index take in segsum mode (no [N, E] constants)."""
         if self._mode == "matmul":
             return (x_n.astype(self._cnt) @ self._A_in_c) > 0.5
-        return jnp.take(x_n, self._edge_dst_j, axis=-1)
+        return jnp.take(x_n, self._edge_dst, axis=-1)
 
     def _spread_src(self, x_n):
         """[..., N] bool -> [..., E]: broadcast a per-node flag to its
         outbound edges (marker re-broadcast targets)."""
         if self._mode == "matmul":
             return (x_n.astype(self._cnt) @ self._A_out_c) > 0.5
-        return jnp.take(x_n, self._edge_src_j, axis=-1)
+        return jnp.take(x_n, self._edge_src, axis=-1)
 
     # ---- queue primitives ------------------------------------------------
 

@@ -123,6 +123,11 @@ class BatchedRunner:
                 self.config, max_delay=self.delay.max_delay)
         if scheduler not in ("exact", "sync"):
             raise ValueError(f"unknown scheduler {scheduler!r}")
+        if self.config.use_pallas_rec and scheduler != "sync":
+            # the Pallas append lives only in the sync tick; accepting the
+            # flag here would silently measure the jnp path under a config
+            # that claims otherwise
+            raise ValueError("use_pallas_rec requires scheduler='sync'")
         # sync uses the split marker representation (ring content untouched
         # by ticks); exact needs the unified ring for push-order PRNG draws
         self.kernel = TickKernel(

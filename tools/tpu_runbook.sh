@@ -15,13 +15,15 @@ mkdir -p "$OUT"
 cd "$ROOT"
 
 echo "=== 1a. bench (jnp rec path) ==="
-timeout 900 python bench.py --repeats 2 2>"$OUT/bench_plain.err" \
-    | tee "$OUT/bench_plain.json"
+# inner --timeout < outer timeout, so bench's own multi-attempt fallback
+# chain (hang watchdog -> auto -> cpu) can actually run
+timeout 1200 python bench.py --repeats 2 --timeout 300 \
+    2>"$OUT/bench_plain.err" | tee "$OUT/bench_plain.json"
 tail -5 "$OUT/bench_plain.err"
 
 echo "=== 1b. bench (--pallas-rec) ==="
-timeout 900 python bench.py --repeats 2 --pallas-rec 2>"$OUT/bench_pallas.err" \
-    | tee "$OUT/bench_pallas.json"
+timeout 1200 python bench.py --repeats 2 --pallas-rec --timeout 300 \
+    2>"$OUT/bench_pallas.err" | tee "$OUT/bench_pallas.json"
 tail -5 "$OUT/bench_pallas.err"
 
 echo "=== 2. tick profile ==="
@@ -30,12 +32,12 @@ timeout 900 python tools/profile_tick.py --out "$OUT/tickprof" \
 cat "$OUT/profile.txt"
 
 echo "=== 3. ladder (sync + exact) ==="
-timeout 5400 python tools/ladder.py --scheduler both --timeout 600 \
+timeout 7200 python tools/ladder.py --scheduler both --timeout 600 \
     > "$OUT/ladder.jsonl" 2>"$OUT/ladder.err"
 cat "$OUT/ladder.jsonl"
 
 echo "=== 4. maxbatch (ring-10 north-star config) ==="
-timeout 1800 python tools/maxbatch.py --graph ring --nodes 10 \
+timeout 3600 python tools/maxbatch.py --graph ring --nodes 10 \
     --max-snapshots 2 --start 4096 > "$OUT/maxbatch.json" 2>"$OUT/maxbatch.err"
 cat "$OUT/maxbatch.json"
 
