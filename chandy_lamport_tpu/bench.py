@@ -66,6 +66,10 @@ def _parser() -> argparse.ArgumentParser:
                    help="rec_data[S,E,M] dtype — the dominant per-instance "
                         "HBM term; int16 halves it (amounts >= 2^15 flag "
                         "ERR_VALUE_OVERFLOW; the bench sends amount=1)")
+    p.add_argument("--delay", choices=["uniform", "hash"], default="uniform",
+                   help="fast-path delay sampler: threefry-based "
+                        "UniformJaxDelay or the fused counter-hash "
+                        "HashJaxDelay (same distribution, cheaper stream)")
     p.add_argument("--pallas-rec", action="store_true",
                    help="use the Pallas block-skipping kernel for the "
                         "recorded-message append (ops/pallas_rec.py)")
@@ -121,7 +125,7 @@ def run_worker(args) -> int:
         staggered_snapshots,
         storm_program,
     )
-    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
 
     log(f"device: {dev.platform} ({dev.device_kind}); "
@@ -164,7 +168,7 @@ def run_worker(args) -> int:
 
     runner = summary = None
     for cap_try in range(4):
-        runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=17),
+        runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
                                batch=args.batch, scheduler=args.scheduler)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
@@ -186,7 +190,13 @@ def run_worker(args) -> int:
             final = runner.run_storm(runner.init_batch_device(), prog)
             jax.block_until_ready(final)
         except Exception as exc:
-            if "RESOURCE_EXHAUSTED" in str(exc) and args.batch > 1:
+            # device OOM surfaces as RESOURCE_EXHAUSTED locally, but through
+            # the remote-compile tunnel it arrives as INTERNAL with the XLA
+            # message text — match the text, not just the status code
+            oom = any(pat in str(exc) for pat in (
+                "RESOURCE_EXHAUSTED", "Ran out of memory",
+                "Exceeded hbm capacity"))
+            if oom and args.batch > 1:
                 # out of HBM: halve the batch and retry (the result JSON
                 # reports the batch that actually ran, so a shrunken run is
                 # visibly labeled — tools/ladder.py marks it _CLAMPED).
@@ -199,6 +209,10 @@ def run_worker(args) -> int:
             raise
         log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
         summary = BatchedRunner.summarize(final)
+        # free the warmup state NOW: holding it across the timed loop's
+        # fresh init doubles state residency and OOMs the large configs
+        # (config 5: 9 GB resident -> 18 GB transient)
+        del final
         log(f"summary: {summary}")
         bits = summary["error_bits"]
         if not bits:
@@ -239,6 +253,7 @@ def run_worker(args) -> int:
             jax.profiler.stop_trace()
             log(f"profile trace written to {args.profile}")
         total_ticks = int(np.asarray(jax.device_get(final.time)).sum())
+        del state, final  # same double-residency guard, per repeat
         times.append(dt)
         node_ticks.append(total_ticks * topo.n)
         ticks_per_lane = total_ticks / args.batch
@@ -263,6 +278,7 @@ def run_worker(args) -> int:
         "queue_capacity": cfg.queue_capacity,
         "record_dtype": cfg.record_dtype,
         "use_pallas_rec": cfg.use_pallas_rec,
+        "delay": args.delay,
     }
     result.update(_memory_stats(dev))
     print(json.dumps(result), flush=True)

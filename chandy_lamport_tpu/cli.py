@@ -85,7 +85,7 @@ def _cmd_storm(args) -> int:
         staggered_snapshots,
         storm_program,
     )
-    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
     from chandy_lamport_tpu.utils.metrics import (
         conservation_delta,
@@ -107,7 +107,7 @@ def _cmd_storm(args) -> int:
         use_pallas_rec=args.pallas_rec,
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
-    runner = BatchedRunner(spec, cfg, UniformJaxDelay(seed=args.seed),
+    runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
@@ -176,6 +176,9 @@ def main(argv=None) -> int:
                     default="int32")
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
                     default="auto")
+    ps.add_argument("--delay", choices=["uniform", "hash"],
+                    default="uniform",
+                    help="fast-path delay sampler (see bench --delay)")
     ps.add_argument("--pallas-rec", action="store_true",
                     help="Pallas block-skipping recorded-message append "
                          "(sync scheduler only)")

@@ -67,6 +67,16 @@ class JaxDelay:
         dstate, rts = lax.scan(step, dstate, None, length=n)
         return rts.reshape(shape), dstate
 
+    def init_batch_state(self, batch: int) -> Any:
+        """Per-lane state for a [batch]-wide vmap run. Default broadcasts
+        one state to every lane (correct only for samplers whose stream is
+        shared by design, e.g. the Go-exact conformance stream); samplers
+        meant for independent lanes override this to derive a distinct
+        stream per lane."""
+        one = self.init_state()
+        return jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (batch,) + jnp.shape(x)), one)
+
 
 class GoExactJaxDelay(JaxDelay):
     """Bit-exact reference delays (reference sim.go:100-102) under jit.
@@ -131,6 +141,91 @@ class UniformJaxDelay(JaxDelay):
         key, sub = jax.random.split(dstate)
         d = jax.random.randint(sub, shape, 0, self.max_delay, dtype=jnp.int32)
         return time + 1 + d, key
+
+    def init_batch_state(self, batch):
+        base = jax.random.PRNGKey(self.seed)
+        return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(batch, dtype=jnp.uint32))
+
+
+def _lowbias32(x: jnp.ndarray) -> jnp.ndarray:
+    """32-bit finalizer-style mixer (public-domain "lowbias32" constants) —
+    3 shifts + 2 wrapping multiplies, vs threefry's 20 rounds. Quality is
+    ample for a {1..max_delay} delay draw; it is NOT a crypto PRNG."""
+    x = jnp.asarray(x, jnp.uint32)
+    x ^= x >> 16
+    x *= jnp.uint32(0x7FEB352D)
+    x ^= x >> 15
+    x *= jnp.uint32(0x846CA68B)
+    x ^= x >> 16
+    return x
+
+
+class HashJaxDelay(JaxDelay):
+    """Uniform delay in {1..max_delay} from a counter-based integer hash.
+
+    Same distribution as UniformJaxDelay (modulo bias < 2^-29 for
+    max_delay=5), different stream, ~an order of magnitude cheaper: the
+    threefry path materializes a full [S, E] (or [B, S, E]) word tensor per
+    tick through HBM, while this mixer is a handful of VPU ops that XLA
+    fuses straight into the receive-time consumer — no intermediate tensor.
+
+    State is ``(key u32, counter u32)``; a draw hashes the counter through
+    two mix rounds with the key injected between them
+    (``mix(mix(ctr) ^ key)``). Every element of every draw gets a distinct
+    counter, so draws are reproducible; init_batch_state gives each vmap
+    lane the key ``base_key ^ lane·odd`` — an injective map, so no two
+    lanes can ever share a key (and hence a stream), and lane 0 reproduces
+    the single-instance stream exactly.
+    """
+
+    _LANE_MULT = 0x85EBCA6B  # odd -> lane -> key is injective mod 2^32
+
+    def __init__(self, seed: int, max_delay: int = MAX_DELAY):
+        self.seed = seed
+        self.max_delay = max_delay
+
+    def _base_key(self):
+        # mask before uint32(): negative / wide Python ints raise
+        # OverflowError under NumPy 2.x, and the CLI accepts any int seed
+        return _lowbias32(jnp.uint32((self.seed ^ 0x9E3779B9) & 0xFFFFFFFF))
+
+    def init_state(self):
+        return (self._base_key(), jnp.uint32(0))
+
+    def _delays(self, key, idx):
+        return (_lowbias32(_lowbias32(idx) ^ key)
+                % jnp.uint32(self.max_delay)).astype(jnp.int32)
+
+    def draw(self, dstate, time):
+        key, ctr = dstate
+        return (time + 1 + self._delays(key, ctr),
+                (key, ctr + jnp.uint32(1)))
+
+    def draw_many(self, dstate, time, shape):
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        key, ctr = dstate
+        n = 1
+        for dim in shape:
+            n *= dim
+        idx = ctr + jnp.arange(n, dtype=jnp.uint32).reshape(shape)
+        return time + 1 + self._delays(key, idx), (key, ctr + jnp.uint32(n))
+
+    def init_batch_state(self, batch):
+        lane_key = self._base_key() ^ (
+            jnp.arange(batch, dtype=jnp.uint32) * jnp.uint32(self._LANE_MULT))
+        return (lane_key, jnp.zeros(batch, jnp.uint32))
+
+
+def make_fast_delay(name: str, seed: int,
+                    max_delay: int = MAX_DELAY) -> JaxDelay:
+    """The CLI/bench ``--delay`` choices: "uniform" (threefry) or "hash"
+    (fused counter-hash)."""
+    if name == "uniform":
+        return UniformJaxDelay(seed, max_delay)
+    if name == "hash":
+        return HashJaxDelay(seed, max_delay)
+    raise ValueError(f"unknown fast delay sampler {name!r}")
 
 
 def from_host_model(model: DelayModel) -> JaxDelay:

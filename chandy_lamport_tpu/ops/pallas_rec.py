@@ -61,9 +61,12 @@ def _kernel(tile_e, e_dim, dirty_ref, pos_ref, mask_ref, amt_ref,
                 pltpu.make_async_copy(src, dst, sem).start()
                 pltpu.make_async_copy(src, dst, sem).wait()
             m_idx = jax.lax.broadcasted_iota(_i32, (tile_e, m), 1)
-            hit = (mask_v[:] != 0)[:, None] & (m_idx == pos_v[:][:, None])
-            rec_v[:] = jnp.where(
-                hit, amt_v[:][:, None].astype(rec_v.dtype), rec_v[:])
+            # Insert the minor dim on the i32 vectors BEFORE comparing:
+            # Mosaic only supports non-no-op minor-dim insertion for 32-bit
+            # types, so an i1 [:, None] fails to compile on real TPUs.
+            hit = (mask_v[:][:, None] != 0) & (m_idx == pos_v[:][:, None])
+            amt_b = jnp.broadcast_to(amt_v[:][:, None], (tile_e, m))
+            rec_v[:] = jnp.where(hit, amt_b.astype(rec_v.dtype), rec_v[:])
             out = rec_out_ref.at[s, pl.ds(start, tile_e), :]
             pltpu.make_async_copy(rec_v, out, sem).start()
             pltpu.make_async_copy(rec_v, out, sem).wait()

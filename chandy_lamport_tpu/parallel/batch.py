@@ -34,7 +34,7 @@ from chandy_lamport_tpu.core.spec import (
     TickEvent,
 )
 from chandy_lamport_tpu.core.state import DenseState, DenseTopology, init_state
-from chandy_lamport_tpu.ops.delay_jax import JaxDelay, UniformJaxDelay
+from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 from chandy_lamport_tpu.ops.tick import TickKernel
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 
@@ -99,9 +99,10 @@ def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
 class BatchedRunner:
     """Runs a compiled script over B vmapped instances, fully under one jit.
 
-    The delay sampler should be per-instance (``UniformJaxDelay`` folds the
-    lane index into its key); a shared GoExact stream would make every lane
-    identical — valid for testing, pointless for throughput.
+    The delay sampler should be per-instance (UniformJaxDelay and
+    HashJaxDelay derive a distinct stream per lane in init_batch_state); a
+    shared GoExact stream would make every lane identical — valid for
+    testing, pointless for throughput.
     """
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
@@ -194,13 +195,7 @@ class BatchedRunner:
         return self._init_device()
 
     def _batched_delay_state(self):
-        if isinstance(self.delay, UniformJaxDelay):
-            base = jax.random.PRNGKey(self.delay.seed)
-            return jax.vmap(lambda i: jax.random.fold_in(base, i))(
-                jnp.arange(self.batch, dtype=jnp.uint32))
-        one = self.delay.init_state()
-        return jax.tree_util.tree_map(
-            lambda x: jnp.broadcast_to(x, (self.batch,) + jnp.shape(x)), one)
+        return self.delay.init_batch_state(self.batch)
 
     # -- execution ---------------------------------------------------------
 

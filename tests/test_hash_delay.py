@@ -1,0 +1,130 @@
+"""HashJaxDelay: the fused counter-hash fast-path sampler.
+
+Covers the three properties the bench relies on: draws are uniform over
+{1..max_delay} (same distribution as the reference's 1 + Intn(maxDelay),
+sim.go:100-102), streams are reproducible and counter-disjoint (draw vs
+draw_many), and a batched storm under the hash sampler completes with
+per-lane conservation and diverging lanes — mirroring the UniformJaxDelay
+test above it in test_batched.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import decode_snapshot
+from chandy_lamport_tpu.ops.delay_jax import HashJaxDelay, UniformJaxDelay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner, compile_events
+from chandy_lamport_tpu.utils.fixtures import (
+    read_events_file,
+    read_topology_file,
+)
+from chandy_lamport_tpu.utils.goldens import fixture_path
+
+
+def test_hash_delay_range_and_distribution():
+    d = HashJaxDelay(seed=123, max_delay=5)
+    st = d.init_state()
+    rts, st = d.draw_many(st, jnp.int32(0), 50_000)
+    delays = np.asarray(rts) - 1  # time=0 -> rt = 1 + delay offset in {0..4}
+    assert delays.min() >= 0 and delays.max() <= 4
+    counts = np.bincount(delays, minlength=5)
+    # 50k draws, p=0.2: expect 10k per bucket, 5 sigma ~ 450
+    assert np.all(np.abs(counts - 10_000) < 600), counts
+
+
+def test_hash_delay_reproducible_and_counter_disjoint():
+    d = HashJaxDelay(seed=7)
+    st = d.init_state()
+    a, st_a = d.draw_many(st, jnp.int32(3), (4, 6))
+    b, _ = d.draw_many(d.init_state(), jnp.int32(3), (4, 6))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # sequential scalar draws consume the same counters as one bulk draw
+    st2 = d.init_state()
+    singles = []
+    for _ in range(8):
+        rt, st2 = d.draw(st2, jnp.int32(3))
+        singles.append(int(rt))
+    bulk, _ = d.draw_many(d.init_state(), jnp.int32(3), 8)
+    assert singles == list(np.asarray(bulk))
+    # the follow-up draw starts where the bulk draw stopped
+    follow, _ = d.draw_many(st_a, jnp.int32(3), 2)
+    tail, _ = d.draw_many(d.init_state(), jnp.int32(3), 26)
+    np.testing.assert_array_equal(np.asarray(follow),
+                                  np.asarray(tail)[24:])
+
+
+def test_hash_delay_lane_keys_injective_and_lane0_matches_single():
+    """init_batch_state: no two lanes can share a key (lane -> key is
+    injective mod 2^32), and lane 0 reproduces the single-instance
+    stream."""
+    d = HashJaxDelay(seed=42)
+    keys, ctrs = d.init_batch_state(4096)
+    assert len(np.unique(np.asarray(keys))) == 4096
+    assert int(np.asarray(ctrs).sum()) == 0
+    single, _ = d.draw_many(d.init_state(), jnp.int32(5), 64)
+    lane0, _ = d.draw_many((keys[0], ctrs[0]), jnp.int32(5), 64)
+    np.testing.assert_array_equal(np.asarray(single), np.asarray(lane0))
+
+
+def test_hash_delay_distinct_seeds_distinct_streams():
+    a, _ = HashJaxDelay(seed=1).draw_many(
+        HashJaxDelay(seed=1).init_state(), jnp.int32(0), 256)
+    b, _ = HashJaxDelay(seed=2).draw_many(
+        HashJaxDelay(seed=2).init_state(), jnp.int32(0), 256)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hash_delay_storm_lanes_conserve_tokens():
+    """Same invariant suite as the UniformJaxDelay lane test
+    (test_batched.py): every lane completes every snapshot, conserves
+    tokens, and lanes diverge (per-lane seeds really differ)."""
+    topo_spec = read_topology_file(fixture_path("10nodes.top"))
+    events = read_events_file(fixture_path("10nodes.events"))
+    b = 8
+    runner = BatchedRunner(topo_spec, SimConfig(queue_capacity=32),
+                           HashJaxDelay(seed=99), batch=b)
+    script = compile_events(runner.topo, events)
+    host = jax.device_get(runner.run(runner.init_batch(), script))
+
+    assert int(host.error.sum()) == 0
+    total0 = int(runner.topo.tokens0.sum())
+    n = runner.topo.n
+    lanes_diverged = False
+    for i in range(b):
+        lane = jax.tree_util.tree_map(lambda x: x[i], host)
+        assert int(lane.q_len.sum()) == 0
+        assert int(lane.tokens.sum()) == total0
+        for sid in range(int(lane.next_sid)):
+            assert int(lane.completed[sid]) == n
+            snap = decode_snapshot(runner.topo, lane, sid)
+            frozen = sum(snap.token_map.values())
+            recorded = sum(m.message.data for m in snap.messages)
+            assert frozen + recorded == total0
+        if i and not np.array_equal(lane.frozen, host.frozen[0]):
+            lanes_diverged = True
+    assert lanes_diverged
+
+
+def test_hash_delay_matches_uniform_summary_shape():
+    """The hash sampler drops into BatchedRunner wherever UniformJaxDelay
+    does: same storm, same summarize keys, clean completion."""
+    from chandy_lamport_tpu.models.workloads import (
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+
+    spec = scale_free(64, 2, seed=3, tokens=40)
+    cfg = SimConfig.for_workload(snapshots=4)
+    for delay in (UniformJaxDelay(seed=17), HashJaxDelay(seed=17)):
+        runner = BatchedRunner(spec, cfg, delay, batch=4, scheduler="sync")
+        prog = storm_program(
+            runner.topo, phases=8, amount=1,
+            snapshot_phases=staggered_snapshots(runner.topo, 4, 1, 2,
+                                                max_phases=8))
+        summary = BatchedRunner.summarize(
+            runner.run_storm(runner.init_batch_device(), prog))
+        assert summary["error_bits"] == 0
+        assert summary["snapshots_completed"] == summary["snapshots_started"]

@@ -57,6 +57,8 @@ def main() -> None:
     p.add_argument("--scheduler", choices=["sync", "exact", "both"],
                    default="sync")
     p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--delay", choices=["uniform", "hash"], default=None,
+                   help="forwarded to bench --delay")
     p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
     args = p.parse_args()
 
@@ -80,7 +82,17 @@ def main() -> None:
     n = 0
     for name, extra in ladder:
         for sched in schedulers:
-            row = bench(f"{name}_{sched}", extra + ["--scheduler", sched],
+            run = list(extra)
+            if sched == "exact":
+                # the exact scheduler's per-tick lax.scan over N source
+                # slots costs ~8x the sync path's HBM (live scan carries);
+                # starting it at the sync batch just burns OOM-halving
+                # retries (and has crashed the device tunnel) — start small
+                b = run.index("--batch")
+                run[b + 1] = str(max(int(run[b + 1]) // 8, 8))
+            if args.delay:
+                run += ["--delay", args.delay]
+            row = bench(f"{name}_{sched}", run + ["--scheduler", sched],
                         args.timeout)
             print(json.dumps(row), flush=True)
             # append immediately so a later config's crash loses nothing

@@ -32,7 +32,9 @@ timeout 900 python tools/profile_tick.py --out "$OUT/tickprof" \
 cat "$OUT/profile.txt"
 
 echo "=== 3. ladder (sync + exact) ==="
-timeout 7200 python tools/ladder.py --scheduler both --timeout 600 \
+# outer bound must cover the worst case: 8 configs x (hung default attempt
+# + cpu fallback) x 600s inner = 9600s; 10800 leaves headroom
+timeout 10800 python tools/ladder.py --scheduler both --timeout 600 \
     > "$OUT/ladder.jsonl" 2>"$OUT/ladder.err"
 cat "$OUT/ladder.jsonl"
 
