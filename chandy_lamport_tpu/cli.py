@@ -177,8 +177,9 @@ def main(argv=None) -> int:
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
                     default="auto")
     ps.add_argument("--delay", choices=["uniform", "hash"],
-                    default="uniform",
-                    help="fast-path delay sampler (see bench --delay)")
+                    default="hash",
+                    help="fast-path delay sampler (same default as bench "
+                         "--delay)")
     ps.add_argument("--pallas-rec", action="store_true",
                     help="Pallas block-skipping recorded-message append "
                          "(sync scheduler only)")

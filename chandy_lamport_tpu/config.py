@@ -34,7 +34,7 @@ class SimConfig:
     max_recorded: int = 32         # recorded messages per (snapshot, edge) (M)
     max_delay: int = MAX_DELAY
     max_ticks: int = 100_000       # drain-loop budget (guards non-strongly-connected graphs)
-    # dtype of the recorded-message buffer rec_data[S, E, M] — the dominant
+    # dtype of the recorded-message buffer rec_data[S, M, E] — the dominant
     # per-instance HBM term (utils/metrics.instance_footprint_bytes). int16
     # halves it and roughly doubles the max batch; amounts beyond the dtype's
     # range fire ERR_VALUE_OVERFLOW instead of truncating silently.

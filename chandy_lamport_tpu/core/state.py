@@ -22,9 +22,12 @@ State encoding (the jit carry; one instance — batching vmaps the whole tuple):
   - snapshot slot s holds snapshot id s (ids are allocated sequentially from
     0, reference sim.go:107-108, so slot==id while id < S);
   - ``recording[S, E]`` replaces per-snapshot ``isLinkRecording`` maps
-    (node.go:39); ``rec_data[S, E, M]`` + ``rec_len[S, E]`` replace the
+    (node.go:39); ``rec_data[S, M, E]`` + ``rec_len[S, E]`` replace the
     ``incomingMessages`` lists (node.go:38) — only token amounts are stored
-    because only non-marker messages are ever recorded (node.go:174-185);
+    because only non-marker messages are ever recorded (node.go:174-185).
+    The edge axis is minor (E in vector lanes, M on sublanes): M is small
+    (16 default), so an M-minor layout would waste 7/8 of each register
+    and is un-DMA-able by the Pallas rec kernel (ops/pallas_rec.py);
   - ``completed[S]`` replaces the per-snapshot WaitGroup (sim.go:17);
   - ``error`` is a sticky bitmask replacing Go's log.Fatal / unbounded growth
     (checked on the host after a run; SURVEY.md §5 "sanitizer" equivalent).
@@ -157,7 +160,7 @@ class DenseState(NamedTuple):
     done_local: Any    # bool [S, N]
     recording: Any     # bool [S, E]
     rec_len: Any       # i32 [S, E]
-    rec_data: Any      # i32 [S, E, M]
+    rec_data: Any      # i32 [S, M, E]
     completed: Any     # i32 [S]      nodes finalized for this snapshot
     delay_state: Any   # sampler-specific pytree
     error: Any         # i32 [] sticky bitmask
@@ -189,7 +192,7 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         done_local=np.zeros((s, n), b),
         recording=np.zeros((s, e), b),
         rec_len=np.zeros((s, e), i32),
-        rec_data=np.zeros((s, e, m), np.dtype(cfg.record_dtype)),
+        rec_data=np.zeros((s, m, e), np.dtype(cfg.record_dtype)),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
         error=np.int32(0),
@@ -209,7 +212,7 @@ def decode_snapshot(topo: DenseTopology, host: DenseState, sid: int) -> GlobalSn
             for j in range(int(host.rec_len[sid, eidx])):
                 messages.append(MsgSnapshot(
                     src, nid, Message(is_marker=False,
-                                      data=int(host.rec_data[sid, eidx, j]))))
+                                      data=int(host.rec_data[sid, j, eidx]))))
     return GlobalSnapshot(sid, token_map, messages)
 
 

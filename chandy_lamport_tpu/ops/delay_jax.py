@@ -11,8 +11,11 @@ Each sampler carries its own state inside the simulation pytree and exposes
   - GoExactJaxDelay    bit-exact Go stream (draw-order sensitive, needs x64)
   - FixedJaxDelay      constant delay (unit tests, docs)
   - UniformJaxDelay    counter-based threefry uniform {1..max_delay} — same
-                       distribution as the reference, different stream; the
-                       fast path for batched/TPU runs (no x64 needed)
+                       distribution as the reference, different stream
+  - HashJaxDelay       counter-hash uniform {1..max_delay} — same
+                       distribution again, but a few fused VPU ops instead
+                       of a materialized threefry tensor; the default fast
+                       path for batched/TPU runs (bench/storm --delay)
 
 ``from_host_model`` maps the host-side models (models/delay.py) to their JAX
 twins so ``DenseSim`` accepts the same DelayModel objects as the parity

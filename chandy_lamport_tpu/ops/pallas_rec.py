@@ -1,14 +1,14 @@
 """Pallas TPU kernel for the recorded-message append (SURVEY.md §7.2.7).
 
 The sync tick appends at most one amount per (snapshot, edge) column of
-``rec_data[S, E, M]`` per tick (HandleToken, reference node.go:174-185). The
+``rec_data[S, M, E]`` per tick (HandleToken, reference node.go:174-185). The
 XLA formulation is a dense masked select that rewrites the ENTIRE buffer
-every tick — measured 5.3 ms/tick at the bench shape (17% of tick time,
+every tick — measured 5.3 ms/tick at the bench shape (the top line of the
 BASELINE.md op profile) even though only ~N of the S*E columns can change.
 
 XLA cannot skip data-dependently; Pallas can. This kernel:
 
-  - tiles rec_data into [TILE_E, M] blocks that stay in HBM (no automatic
+  - tiles rec_data into [M, TILE_E] blocks that stay in HBM (no automatic
     block pipeline — the whole point is NOT moving clean blocks);
   - receives a scalar-prefetched per-(slot, tile) dirty bitmap, computed
     by the caller as a cheap [S, nTiles] any-reduction of the record mask;
@@ -17,9 +17,16 @@ XLA cannot skip data-dependently; Pallas can. This kernel:
   - for dirty blocks, DMAs the block (and its [TILE_E] metadata slices)
     into VMEM, applies the one-hot append, and DMAs the block back.
 
-A ragged edge count is handled by OVERLAPPING the last tile (start clamped
-to E - TILE_E): the append is a pure idempotent assignment, so columns
-covered by two tiles converge to the same value.
+Layout and alignment (why [S, M, E] and not [S, E, M]): Mosaic requires a
+manually-DMA'd HBM slice to be lane-aligned — the sliced minor dim must be
+a multiple of 128 and slice starts provably divisible by the tiling. With
+the edge axis minor, every block start is ``t * tile_e`` (tile_e a multiple
+of 128) and every block width a multiple of 128; M rides the sublane axis
+(full dim, no constraint beyond M % 8 == 0). The [S, E, M] layout is
+uncompilable on hardware (M=16 lanes) AND wastes 7/8 of each vector
+register in the XLA fallback. Edges past the last 128-aligned boundary
+(E % 128 of them) are handled by the caller with the jnp formulation — a
+sub-1% slice.
 
 Traffic collapses from S*E*M*itemsize per tick to (dirty tiles) x block
 size — at the bench shape the dirty column fraction is ~N/(S*E) ~ 4%.
@@ -39,101 +46,142 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _i32 = jnp.int32
+_LANE = 128  # TPU vector lane count — the kernel's edge-axis granularity
 
 
-def _kernel(tile_e, e_dim, dirty_ref, pos_ref, mask_ref, amt_ref,
+def _kernel(tile_e, e_kernel, dirty_ref, posm_ref, amt_ref,
             rec_in_ref, rec_out_ref):
     s = pl.program_id(0)
     t = pl.program_id(1)
-    m = rec_in_ref.shape[-1]
-    start = jnp.minimum(t * tile_e, e_dim - tile_e)
+    m = rec_in_ref.shape[1]
+    n_full = e_kernel // tile_e
+    tail = e_kernel - n_full * tile_e
+    start = t * tile_e  # every block start is tile_e-aligned by construction
 
-    @pl.when(dirty_ref[s, t] != 0)
-    def _():
-        def inner(rec_v, pos_v, mask_v, amt_v, sem):
+    def block(width):
+        """Process edges [start, start+width) for a static width (tile_e
+        for full blocks, the 128-aligned remainder for the final block)."""
+        def inner(rec_v, sem):
             pltpu.make_async_copy(
-                rec_in_ref.at[s, pl.ds(start, tile_e), :], rec_v, sem).start()
+                rec_in_ref.at[s, :, pl.ds(start, width)], rec_v, sem).start()
             pltpu.make_async_copy(
-                rec_in_ref.at[s, pl.ds(start, tile_e), :], rec_v, sem).wait()
-            for src, dst in ((pos_ref.at[s, pl.ds(start, tile_e)], pos_v),
-                             (mask_ref.at[s, pl.ds(start, tile_e)], mask_v),
-                             (amt_ref.at[0, pl.ds(start, tile_e)], amt_v)):
-                pltpu.make_async_copy(src, dst, sem).start()
-                pltpu.make_async_copy(src, dst, sem).wait()
-            m_idx = jax.lax.broadcasted_iota(_i32, (tile_e, m), 1)
-            # Insert the minor dim on the i32 vectors BEFORE comparing:
-            # Mosaic only supports non-no-op minor-dim insertion for 32-bit
-            # types, so an i1 [:, None] fails to compile on real TPUs.
-            hit = (mask_v[:][:, None] != 0) & (m_idx == pos_v[:][:, None])
-            amt_b = jnp.broadcast_to(amt_v[:][:, None], (tile_e, m))
+                rec_in_ref.at[s, :, pl.ds(start, width)], rec_v, sem).wait()
+            # metadata arrives via the automatic BlockSpec pipeline (tiny
+            # (1, tile_e) tiles — always fetched, ~1% of the rec block);
+            # only the big rec buffer uses manual skipping DMA, because
+            # Mosaic's manual-DMA alignment rules reject single-row slices
+            # of sublane-tiled 2D arrays and sub-1024 slices of 1D arrays.
+            posm_v = posm_ref[0, 0, pl.ds(0, width)]
+            amt_v = amt_ref[0, pl.ds(0, width)]
+            m_idx = jax.lax.broadcasted_iota(_i32, (m, width), 0)
+            # [None, :] inserts a MAJOR (sublane) dim — cheap broadcast;
+            # a minor-dim insertion on non-32-bit types fails Mosaic. The
+            # mask is packed into posm as the sentinel M (m_idx < M never
+            # matches), so one comparison does hit-and-mask at once.
+            hit = m_idx == posm_v[None, :]
+            amt_b = jnp.broadcast_to(amt_v[None, :], (m, width))
             rec_v[:] = jnp.where(hit, amt_b.astype(rec_v.dtype), rec_v[:])
-            out = rec_out_ref.at[s, pl.ds(start, tile_e), :]
+            out = rec_out_ref.at[s, :, pl.ds(start, width)]
             pltpu.make_async_copy(rec_v, out, sem).start()
             pltpu.make_async_copy(rec_v, out, sem).wait()
 
         pl.run_scoped(
             inner,
-            pltpu.VMEM((tile_e, m), rec_in_ref.dtype),
-            pltpu.VMEM((tile_e,), _i32),
-            pltpu.VMEM((tile_e,), _i32),
-            pltpu.VMEM((tile_e,), _i32),
+            pltpu.VMEM((m, width), rec_in_ref.dtype),
             pltpu.SemaphoreType.DMA(()),
         )
+
+    dirty = dirty_ref[s, t] != 0
+    if n_full:
+        @pl.when(dirty & (t < n_full))
+        def _():
+            block(tile_e)
+    if tail:
+        @pl.when(dirty & (t == n_full))
+        def _():
+            block(tail)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_e", "interpret"),
                    donate_argnums=0)
 def rec_append(rec_data, rec_len, rec_mask, amt_e, *, tile_e: int = 512,
                interpret: bool = False):
-    """In-place-append ``amt_e[e]`` at ``rec_data[s, e, rec_len[s, e]]`` for
-    every (s, e) with ``rec_mask[s, e]`` — skipping clean [tile_e, M] blocks
+    """In-place-append ``amt_e[e]`` at ``rec_data[s, rec_len[s, e], e]`` for
+    every (s, e) with ``rec_mask[s, e]`` — skipping clean [M, tile_e] blocks
     entirely. The caller advances rec_len and raises the overflow flags (the
     kernel clips like the jnp path, so flagged-overflow states stay
     bit-identical to it).
 
-    Shapes: rec_data [S, E, M], rec_len/rec_mask [S, E], amt_e [E];
-    E >= tile_e (shrink tile_e for tiny graphs).
+    Shapes: rec_data [S, M, E], rec_len/rec_mask [S, E], amt_e [E]. Any E:
+    the kernel covers the first E - E%128 edges (128-aligned blocks; tile_e
+    must be a multiple of 128 for hardware); the ragged remainder goes
+    through the jnp formulation. M must be a multiple of 8 (sublane tile).
     """
-    s_dim, e_dim, m_dim = rec_data.shape
-    if e_dim < tile_e:
-        raise ValueError(f"E={e_dim} < tile_e={tile_e}; shrink tile_e")
-    n_tiles = pl.cdiv(e_dim, tile_e)
+    s_dim, m_dim, e_dim = rec_data.shape
+    if tile_e % _LANE:
+        raise ValueError(f"tile_e={tile_e} must be a multiple of {_LANE}")
+    if m_dim % 8:
+        raise ValueError(
+            f"max_recorded={m_dim} must be a multiple of 8 for the Pallas "
+            "rec kernel; round it up or disable use_pallas_rec")
+    e_kernel = (e_dim // _LANE) * _LANE
     pos = jnp.clip(rec_len, 0, m_dim - 1).astype(_i32)
-    mask_i = rec_mask.astype(_i32)
-    pad = n_tiles * tile_e - e_dim
-    dirty = jnp.any(
-        jnp.pad(rec_mask, ((0, 0), (0, pad))).reshape(
-            s_dim, n_tiles, tile_e), axis=-1).astype(_i32)
+    amt_i = amt_e.astype(_i32)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(s_dim, n_tiles),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),  # pos (manual DMA)
-            pl.BlockSpec(memory_space=pl.ANY),  # mask
-            pl.BlockSpec(memory_space=pl.ANY),  # amt [1, E]
-            pl.BlockSpec(memory_space=pl.ANY),  # rec_data (HBM, aliased)
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-    )
-    return pl.pallas_call(
-        functools.partial(_kernel, tile_e, e_dim),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(rec_data.shape, rec_data.dtype),
-        # operand indices include the scalar-prefetch arg: dirty=0, pos=1,
-        # mask=2, amt=3, rec_data=4 — alias rec_data to the single output
-        input_output_aliases={4: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
-        interpret=interpret,
-    )(dirty, pos, mask_i, amt_e.astype(_i32)[None, :], rec_data)
+    if e_kernel:
+        n_tiles = pl.cdiv(e_kernel, tile_e)
+        pad = n_tiles * tile_e - e_kernel
+        dirty = jnp.any(
+            jnp.pad(rec_mask[:, :e_kernel], ((0, 0), (0, pad))).reshape(
+                s_dim, n_tiles, tile_e), axis=-1).astype(_i32)
+        # mask packed into pos via the sentinel M (m_idx < M never matches);
+        # the singleton middle dim satisfies the block-shape rule (last two
+        # block dims must divide 8/128 or equal the array dims)
+        posm = jnp.where(rec_mask, pos, m_dim)[:, None, :]
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(s_dim, n_tiles),
+            in_specs=[
+                # metadata rides the automatic pipeline in tile_e-wide
+                # tiles; index_map args: grid indices then scalar-prefetch
+                pl.BlockSpec((1, 1, tile_e), lambda s, t, *_: (s, 0, t)),
+                pl.BlockSpec((1, tile_e), lambda s, t, *_: (0, t)),
+                pl.BlockSpec(memory_space=pl.ANY),  # rec_data (HBM, aliased)
+            ],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        )
+        rec_data = pl.pallas_call(
+            functools.partial(_kernel, tile_e, e_kernel),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(rec_data.shape, rec_data.dtype),
+            # operand indices include the scalar-prefetch arg: dirty=0,
+            # posm=1, amt=2, rec_data=3 — alias rec_data to the single
+            # output
+            input_output_aliases={3: 0},
+            compiler_params=pltpu.CompilerParams(has_side_effects=True),
+            interpret=interpret,
+        )(dirty, posm, amt_i[None, :], rec_data)
+
+    if e_kernel < e_dim:
+        # ragged remainder (< 128 edges): the jnp formulation on the tail
+        # slice only — an in-place dynamic-update-slice under donation
+        m_idx = jnp.arange(m_dim, dtype=_i32)[None, :, None]
+        hit = (rec_mask[:, None, e_kernel:]
+               & (m_idx == pos[:, None, e_kernel:]))
+        upd = jnp.where(hit,
+                        amt_i[None, None, e_kernel:].astype(rec_data.dtype),
+                        rec_data[:, :, e_kernel:])
+        rec_data = rec_data.at[:, :, e_kernel:].set(upd)
+    return rec_data
 
 
 def rec_append_reference(rec_data, rec_len, rec_mask, amt_e):
     """The jnp formulation (what TickKernel._sync_tick inlines) — the
-    numeric ground truth for the kernel tests."""
-    m = rec_data.shape[-1]
-    pos = jnp.clip(rec_len, 0, m - 1)
-    hit = rec_mask[:, :, None] & (
-        jnp.arange(m, dtype=_i32)[None, None, :] == pos[:, :, None])
-    return jnp.where(hit, amt_e.astype(rec_data.dtype)[None, :, None],
+    numeric ground truth for the kernel tests. Shapes as in rec_append."""
+    m = rec_data.shape[1]
+    pos = jnp.clip(rec_len, 0, m - 1).astype(_i32)
+    hit = rec_mask[:, None, :] & (
+        jnp.arange(m, dtype=_i32)[None, :, None] == pos[:, None, :])
+    return jnp.where(hit, amt_e.astype(rec_data.dtype)[None, None, :],
                      rec_data)

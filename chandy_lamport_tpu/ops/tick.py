@@ -178,7 +178,7 @@ class TickKernel:
                             else jnp.asarray(a_in, self._cnt))
             self._A_out_c = jnp.asarray(a_out, self._cnt)
         # recorded amounts beyond the record dtype's range must flag, not
-        # silently truncate (record_dtype shrinks rec_data[S, E, M] HBM)
+        # silently truncate (record_dtype shrinks rec_data[S, M, E] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
         self.tick = jax.jit(self._tick, donate_argnums=0)
@@ -332,7 +332,7 @@ class TickKernel:
         cond = s.recording[:, e]                       # [S]
         pos = jnp.clip(s.rec_len[:, e], 0, M - 1)      # [S]
         rows = jnp.arange(S)
-        col = s.rec_data[:, e, :]                      # [S, M]
+        col = s.rec_data[:, :, e]                      # [S, M]
         amount_r = jnp.asarray(amount, self._rec_dtype)
         col = col.at[rows, pos].set(
             jnp.where(cond, amount_r, col[rows, pos]))
@@ -344,7 +344,7 @@ class TickKernel:
             ERR_VALUE_OVERFLOW, 0).astype(_i32)
         return s._replace(
             tokens=s.tokens.at[dst].add(jnp.asarray(amount, _i32)),
-            rec_data=s.rec_data.at[:, e, :].set(col),
+            rec_data=s.rec_data.at[:, :, e].set(col),
             rec_len=s.rec_len.at[:, e].add(cond.astype(_i32)),
             error=err,
         )
@@ -476,7 +476,6 @@ class TickKernel:
 
             rec_data = pallas_rec.rec_append(
                 s.rec_data, s.rec_len, rec_mask, amt_e,
-                tile_e=min(512, E),
                 interpret=jax.default_backend() != "tpu")
         else:
             # the same formulation the kernel tests use as ground truth —

@@ -122,7 +122,7 @@ class ShardedState(NamedTuple):
     done_local: Any  # bool [P, S, Nl]
     recording: Any   # bool [P, S, Em]
     rec_len: Any     # i32 [P, S, Em]
-    rec_data: Any    # i32 [P, S, Em, M]
+    rec_data: Any    # i32 [P, S, M, Em] (edge axis minor, as in DenseState)
     completed: Any   # i32 [S] (replicated)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
@@ -287,7 +287,7 @@ class GraphShardedRunner:
             done_local=np.zeros((p, s, nl), np.bool_),
             recording=np.zeros((p, s, em), np.bool_),
             rec_len=np.zeros((p, s, em), np.int32),
-            rec_data=np.zeros((p, s, em, m), np.dtype(self.config.record_dtype)),
+            rec_data=np.zeros((p, s, m, em), np.dtype(self.config.record_dtype)),
             completed=np.zeros(s, np.int32),
             delay_key=keys,
             error=np.int32(0),
@@ -785,8 +785,11 @@ class GraphShardedRunner:
         def edges(x):   # [P, Em, ...] -> [E, ...]
             return np.asarray(x)[es, el]
 
-        def slot_edges(x):  # [P, S, Em, ...] -> [S, E, ...]
+        def slot_edges(x):  # [P, S, Em] -> [S, E]
             return np.moveaxis(np.asarray(x)[es, :, el], 1, 0)
+
+        def slot_m_edges(x):  # [P, S, M, Em] -> [S, M, E]
+            return np.moveaxis(np.asarray(x)[es, :, :, el], 0, -1)
 
         return DenseState(
             time=np.asarray(h.time),
@@ -812,7 +815,7 @@ class GraphShardedRunner:
             done_local=nodes(h.done_local),
             recording=slot_edges(h.recording),
             rec_len=slot_edges(h.rec_len),
-            rec_data=slot_edges(h.rec_data),
+            rec_data=slot_m_edges(h.rec_data),
             completed=np.asarray(h.completed),
             delay_state=(),
             error=np.asarray(h.error),

@@ -86,7 +86,7 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
 
     Dominant term at bench shapes is the recorded-message buffer
-    ``rec_data[S, E, M]`` (rec·S·E·M) plus the ``[S, E]`` recording and
+    ``rec_data[S, M, E]`` (rec·S·E·M) plus the ``[S, E]`` recording and
     split-marker planes — size S and M to the workload, not to the worst
     case.
     """

@@ -119,7 +119,7 @@ def test_sync_reduce_modes_match_oracle(case, mode, cnt):
             assert oracle.frozen[sid][node] == int(lane.frozen[sid, node])
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            got = [int(lane.rec_data[sid, e, j])
+            got = [int(lane.rec_data[sid, j, e])
                    for j in range(int(lane.rec_len[sid, e]))]
             assert want == got
 

@@ -86,12 +86,16 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
         got = np.concatenate(parts, axis=0)
         want = getattr(ref_final, name)[perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    for name in ("recording", "rec_len", "rec_data", "m_pending", "m_rtime",
-                 "m_seq"):
+    for name in ("recording", "rec_len", "m_pending", "m_rtime", "m_seq"):
         parts = [getattr(final, name)[p][:, :counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=1)
         want = getattr(ref_final, name)[:, perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
+    # rec_data's edge axis is minor: [S, M, Em]
+    parts = [final.rec_data[p][:, :, :counts[p]] for p in range(shards)]
+    got = np.concatenate(parts, axis=2)
+    np.testing.assert_array_equal(got, ref_final.rec_data[:, :, perm],
+                                  err_msg="rec_data")
 
 
 def test_sharded_uniform_stream_invariants():
@@ -114,6 +118,7 @@ def test_sharded_uniform_stream_invariants():
         recorded = 0
         for p in range(4):
             for j in range(final.rec_len.shape[-1]):
-                recorded += int(final.rec_data[p][sid, j,
-                                                  :final.rec_len[p][sid, j]].sum())
+                recorded += int(final.rec_data[p][sid,
+                                                  :final.rec_len[p][sid, j],
+                                                  j].sum())
         assert frozen + recorded == int(gs.topo.tokens0.sum())

@@ -16,7 +16,7 @@ from chandy_lamport_tpu.ops.pallas_rec import rec_append, rec_append_reference
 
 def _case(seed, s=4, e=256, m=8, dtype=jnp.int16, density=0.05):
     rng = np.random.RandomState(seed)
-    rec = jnp.asarray(rng.randint(0, 100, (s, e, m)), dtype)
+    rec = jnp.asarray(rng.randint(0, 100, (s, m, e)), dtype)
     rec_len = jnp.asarray(rng.randint(0, m + 2, (s, e)), jnp.int32)
     mask = jnp.asarray(rng.rand(s, e) < density)
     amt = jnp.asarray(rng.randint(1, 1000, (e,)), jnp.int32)
@@ -28,26 +28,28 @@ def _case(seed, s=4, e=256, m=8, dtype=jnp.int16, density=0.05):
     (1, jnp.int32, 0.3, 256),
     (2, jnp.int16, 0.0, 256),   # nothing dirty: every block skipped
     (3, jnp.int32, 1.0, 256),   # everything dirty
-    (4, jnp.int16, 0.2, 250),   # ragged E: overlapping last tile
-    (5, jnp.int32, 0.5, 65),    # one full + one almost-fully-overlapped tile
+    (4, jnp.int16, 0.2, 250),   # ragged: 1 kernel tile + 122-edge remainder
+    (5, jnp.int32, 0.5, 65),    # sub-lane E: pure jnp remainder path
+    (6, jnp.int16, 0.3, 384),   # full tiles + 128-aligned tail block
 ])
 def test_matches_reference(seed, dtype, density, e):
     rec, rec_len, mask, amt = _case(seed, e=e, dtype=dtype, density=density)
     want = rec_append_reference(rec, rec_len, mask, amt)
-    got = rec_append(rec, rec_len, mask, amt, tile_e=64, interpret=True)
+    got = rec_append(rec, rec_len, mask, amt, tile_e=128, interpret=True)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
 def test_clean_blocks_preserved_via_aliasing():
     """A block with no dirty column must come through bit-identical — the
     aliased in-place semantics the skip relies on."""
-    rec, rec_len, _, amt = _case(7, e=128)
-    mask = jnp.zeros((rec.shape[0], rec.shape[1]), bool).at[:, :64].set(
-        jnp.asarray(np.random.RandomState(0).rand(rec.shape[0], 64) < 0.2))
-    got = rec_append(rec.copy(), rec_len, mask, amt, tile_e=64,
+    rec, rec_len, _, amt = _case(7, e=256)
+    mask = jnp.zeros((rec.shape[0], rec.shape[-1]), bool).at[:, :128].set(
+        jnp.asarray(np.random.RandomState(0).rand(rec.shape[0], 128) < 0.2))
+    got = rec_append(rec.copy(), rec_len, mask, amt, tile_e=128,
                      interpret=True)
-    # the second tile (columns 64..128) is untouched
-    np.testing.assert_array_equal(np.asarray(got)[:, 64:], np.asarray(rec)[:, 64:])
+    # the second tile (edges 128..256) is untouched
+    np.testing.assert_array_equal(np.asarray(got)[:, :, 128:],
+                                  np.asarray(rec)[:, :, 128:])
     want = rec_append_reference(rec, rec_len, mask, amt)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
@@ -89,12 +91,12 @@ def test_sync_scheduler_with_pallas_rec_matches_plain():
 def test_vmapped_batch_axis():
     """The bench path vmaps the tick over instances; the kernel must
     batch correctly (pallas_call's batching rule adds a grid dim)."""
-    cases = [_case(10 + i, e=128) for i in range(3)]
+    cases = [_case(10 + i, e=256) for i in range(3)]
     rec = jnp.stack([c[0] for c in cases])
     rec_len = jnp.stack([c[1] for c in cases])
     mask = jnp.stack([c[2] for c in cases])
     amt = jnp.stack([c[3] for c in cases])
     want = jax.vmap(rec_append_reference)(rec, rec_len, mask, amt)
     got = jax.vmap(lambda r, l, k, a: rec_append(
-        r, l, k, a, tile_e=64, interpret=True))(rec, rec_len, mask, amt)
+        r, l, k, a, tile_e=128, interpret=True))(rec, rec_len, mask, amt)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -93,6 +93,6 @@ def test_dense_sync_matches_oracle(case):
         # recorded channel contents, per edge in arrival order
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            got = [int(lane.rec_data[sid, e, j])
+            got = [int(lane.rec_data[sid, j, e])
                    for j in range(int(lane.rec_len[sid, e]))]
             assert want == got, f"sid {sid} edge {e}"

@@ -61,6 +61,9 @@ def main() -> None:
     p.add_argument("--reduce-mode", default="auto",
                    choices=["auto", "matmul", "segsum"])
     p.add_argument("--snapshots", type=int, default=8)
+    p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
+                   help="same knob as bench --delay")
+    p.add_argument("--pallas-rec", action="store_true")
     p.add_argument("--out", default="/tmp/tickprof")
     p.add_argument("--top", type=int, default=18)
     args = p.parse_args()
@@ -69,7 +72,7 @@ def main() -> None:
 
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.models.workloads import scale_free
-    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
 
     dev = jax.devices()[0]
@@ -77,10 +80,11 @@ def main() -> None:
 
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
                                  record_dtype="int16",
-                                 reduce_mode=args.reduce_mode)
+                                 reduce_mode=args.reduce_mode,
+                                 use_pallas_rec=args.pallas_rec)
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
-                           cfg, UniformJaxDelay(seed=17), batch=args.batch,
-                           scheduler="sync")
+                           cfg, make_fast_delay(args.delay, 17),
+                           batch=args.batch, scheduler="sync")
     print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
           f"mode={runner.kernel._mode}", file=sys.stderr)
 
