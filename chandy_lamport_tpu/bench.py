@@ -61,6 +61,11 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--capacity", type=int, default=0,
                    help="per-edge queue slots; 0 = size to the workload "
                         "(SimConfig.for_workload)")
+    p.add_argument("--max-recorded", type=int, default=16,
+                   help="records per (snapshot, edge) slot M — rec_data is "
+                        "the dominant HBM term and its per-tick rewrite the "
+                        "top profile line; ERR_RECORD_OVERFLOW + the "
+                        "doubling retry keep a small M honest")
     p.add_argument("--record-dtype", choices=["int16", "int32"],
                    default="int16",
                    help="rec_data[S,M,E] dtype — the dominant per-instance "
@@ -161,9 +166,15 @@ def run_worker(args) -> int:
     if args.pallas_rec and args.scheduler != "sync":
         log("ERROR: --pallas-rec only affects the sync scheduler")
         return 1
-    cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
+    if args.pallas_rec and args.max_recorded % 8:
+        log("ERROR: --pallas-rec needs --max-recorded divisible by 8 "
+            "(TPU sublane tile)")
+        return 1
+    cfg = SimConfig.for_workload(snapshots=args.snapshots,
+                                 max_recorded=args.max_recorded,
                                  record_dtype=args.record_dtype,
-                                 use_pallas_rec=args.pallas_rec)
+                                 use_pallas_rec=args.pallas_rec,
+                                 split_markers=args.scheduler == "sync")
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
 
@@ -279,6 +290,7 @@ def run_worker(args) -> int:
         "queue_capacity": cfg.queue_capacity,
         "record_dtype": cfg.record_dtype,
         "use_pallas_rec": cfg.use_pallas_rec,
+        "max_recorded": cfg.max_recorded,
         "delay": args.delay,
     }
     result.update(_memory_stats(dev))

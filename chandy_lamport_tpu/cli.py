@@ -100,11 +100,16 @@ def _cmd_storm(args) -> int:
     if args.pallas_rec and args.scheduler != "sync":
         print("--pallas-rec only affects the sync scheduler", file=sys.stderr)
         return 2
+    if args.pallas_rec and args.max_recorded % 8:
+        print("--pallas-rec needs --max-recorded divisible by 8 "
+              "(TPU sublane tile)", file=sys.stderr)
+        return 2
     spec = gen()
     cfg = SimConfig.for_workload(
         snapshots=args.snapshots, max_recorded=args.max_recorded,
         record_dtype=args.record_dtype, reduce_mode=args.reduce_mode,
         use_pallas_rec=args.pallas_rec,
+        split_markers=args.scheduler == "sync",
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),

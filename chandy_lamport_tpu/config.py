@@ -72,6 +72,7 @@ class SimConfig:
     @classmethod
     def for_workload(cls, *, snapshots: int, max_delay: int = MAX_DELAY,
                      sends_per_edge_per_phase: int = 1, hol_slack: int = 8,
+                     split_markers: bool = False,
                      **overrides) -> "SimConfig":
         """A SimConfig whose queue capacity is sized to the workload instead
         of guessed (the round-2 bench zeroed itself because the default C=16
@@ -93,12 +94,21 @@ class SimConfig:
                       ``hol_slack`` covers it (measured: the sf-1024 bench
                       storm peaks ~17 on hub edges with snapshots=8).
 
+        ``split_markers=True`` drops the marker term: the sync scheduler's
+        split representation (TickKernel marker_mode="split") keeps markers
+        in their own [S, E] planes, so the ring only ever holds tokens —
+        at the bench workload that takes C from 24 to 16 and cuts every
+        [E, C] array's traffic by a third (measured +5% node-ticks/s).
+        Pass it only for sync-scheduler runs; the exact scheduler's ring
+        mode needs the marker slots.
+
         The result is rounded up to a multiple of 8 (friendlier [E, C] lane
         tiling) with a floor of 16. Overflow still flags ERR_QUEUE_OVERFLOW —
         this sizes away the default-workload failure, it does not remove the
         check.
         """
-        analytic = snapshots + sends_per_edge_per_phase * (max_delay + 1)
+        analytic = ((0 if split_markers else snapshots)
+                    + sends_per_edge_per_phase * (max_delay + 1))
         c = max(16, analytic + hol_slack)
         overrides.setdefault("max_snapshots", max(8, snapshots))
         # an explicit queue_capacity override wins over the derived size

@@ -166,12 +166,10 @@ def rec_append(rec_data, rec_len, rec_mask, amt_e, *, tile_e: int = 512,
     if e_kernel < e_dim:
         # ragged remainder (< 128 edges): the jnp formulation on the tail
         # slice only — an in-place dynamic-update-slice under donation
-        m_idx = jnp.arange(m_dim, dtype=_i32)[None, :, None]
-        hit = (rec_mask[:, None, e_kernel:]
-               & (m_idx == pos[:, None, e_kernel:]))
-        upd = jnp.where(hit,
-                        amt_i[None, None, e_kernel:].astype(rec_data.dtype),
-                        rec_data[:, :, e_kernel:])
+        upd = rec_append_reference(rec_data[:, :, e_kernel:],
+                                   rec_len[:, e_kernel:],
+                                   rec_mask[:, e_kernel:],
+                                   amt_e[e_kernel:])
         rec_data = rec_data.at[:, :, e_kernel:].set(upd)
     return rec_data
 

@@ -174,6 +174,11 @@ def test_for_workload_sizes_the_bench_config():
     # the capacity measured overflow-free at the bench shape (round-2 VERDICT)
     assert cfg.queue_capacity == 24
     assert cfg.max_snapshots == 8
+    # split-marker mode: markers live in their own [S, E] planes, so the
+    # marker term drops out of the ring sizing (bench sync default; C=16
+    # measured overflow-free at the bench shape)
+    assert SimConfig.for_workload(
+        snapshots=8, split_markers=True).queue_capacity == 16
     # floor and rounding
     assert SimConfig.for_workload(snapshots=1, hol_slack=0).queue_capacity == 16
     assert SimConfig.for_workload(snapshots=16).queue_capacity % 8 == 0

@@ -23,19 +23,20 @@ def _case(seed, s=4, e=256, m=8, dtype=jnp.int16, density=0.05):
     return rec, rec_len, mask, amt
 
 
-@pytest.mark.parametrize("seed,dtype,density,e", [
-    (0, jnp.int16, 0.05, 256),
-    (1, jnp.int32, 0.3, 256),
-    (2, jnp.int16, 0.0, 256),   # nothing dirty: every block skipped
-    (3, jnp.int32, 1.0, 256),   # everything dirty
-    (4, jnp.int16, 0.2, 250),   # ragged: 1 kernel tile + 122-edge remainder
-    (5, jnp.int32, 0.5, 65),    # sub-lane E: pure jnp remainder path
-    (6, jnp.int16, 0.3, 384),   # full tiles + 128-aligned tail block
+@pytest.mark.parametrize("seed,dtype,density,e,tile_e", [
+    (0, jnp.int16, 0.05, 256, 128),
+    (1, jnp.int32, 0.3, 256, 128),
+    (2, jnp.int16, 0.0, 256, 128),   # nothing dirty: every block skipped
+    (3, jnp.int32, 1.0, 256, 128),   # everything dirty
+    (4, jnp.int16, 0.2, 250, 128),   # ragged: 1 tile + 122-edge remainder
+    (5, jnp.int32, 0.5, 65, 128),    # sub-lane E: pure jnp remainder path
+    (6, jnp.int16, 0.3, 384, 256),   # full tile + 128-wide TAIL block
+    (7, jnp.int32, 0.2, 700, 256),   # 2 full + tail 128 + 60-edge remainder
 ])
-def test_matches_reference(seed, dtype, density, e):
+def test_matches_reference(seed, dtype, density, e, tile_e):
     rec, rec_len, mask, amt = _case(seed, e=e, dtype=dtype, density=density)
     want = rec_append_reference(rec, rec_len, mask, amt)
-    got = rec_append(rec, rec_len, mask, amt, tile_e=128, interpret=True)
+    got = rec_append(rec, rec_len, mask, amt, tile_e=tile_e, interpret=True)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 

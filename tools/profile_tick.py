@@ -81,7 +81,8 @@ def main() -> None:
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
                                  record_dtype="int16",
                                  reduce_mode=args.reduce_mode,
-                                 use_pallas_rec=args.pallas_rec)
+                                 use_pallas_rec=args.pallas_rec,
+                                 split_markers=True)
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
                            cfg, make_fast_delay(args.delay, 17),
                            batch=args.batch, scheduler="sync")
