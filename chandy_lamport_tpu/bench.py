@@ -61,24 +61,21 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--capacity", type=int, default=0,
                    help="per-edge queue slots; 0 = size to the workload "
                         "(SimConfig.for_workload)")
-    p.add_argument("--max-recorded", type=int, default=16,
-                   help="records per (snapshot, edge) slot M — rec_data is "
-                        "the dominant HBM term and its per-tick rewrite the "
-                        "top profile line; ERR_RECORD_OVERFLOW + the "
-                        "doubling retry keep a small M honest")
+    p.add_argument("--max-recorded", type=int, default=0,
+                   help="per-edge recorded-arrival log slots L (0 = derived "
+                        "from the snapshot count by SimConfig.for_workload); "
+                        "ERR_RECORD_OVERFLOW + the doubling retry keep a "
+                        "small L honest")
     p.add_argument("--record-dtype", choices=["int16", "int32"],
                    default="int16",
-                   help="rec_data[S,M,E] dtype — the dominant per-instance "
-                        "HBM term; int16 halves it (amounts >= 2^15 flag "
-                        "ERR_VALUE_OVERFLOW; the bench sends amount=1)")
+                   help="log_amt[L,E] dtype; int16 halves it (amounts >= "
+                        "2^15 flag ERR_VALUE_OVERFLOW; the bench sends "
+                        "amount=1)")
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="fast-path delay sampler: the fused counter-hash "
                         "HashJaxDelay (default — same distribution as the "
                         "threefry UniformJaxDelay, ~10%% faster at the "
                         "bench shape) or 'uniform' for the threefry stream")
-    p.add_argument("--pallas-rec", action="store_true",
-                   help="use the Pallas block-skipping kernel for the "
-                        "recorded-message append (ops/pallas_rec.py)")
     p.add_argument("--target", type=float, default=10e6,
                    help="north-star node-ticks/sec/chip (BASELINE.json)")
     p.add_argument("--profile", metavar="DIR", default=None,
@@ -163,17 +160,9 @@ def run_worker(args) -> int:
     # (a ring's marker circles the whole graph, recording a token per tick
     # on every edge — small graphs legitimately need M much larger than the
     # scale-free default)
-    if args.pallas_rec and args.scheduler != "sync":
-        log("ERROR: --pallas-rec only affects the sync scheduler")
-        return 1
-    if args.pallas_rec and args.max_recorded % 8:
-        log("ERROR: --pallas-rec needs --max-recorded divisible by 8 "
-            "(TPU sublane tile)")
-        return 1
     cfg = SimConfig.for_workload(snapshots=args.snapshots,
                                  max_recorded=args.max_recorded,
                                  record_dtype=args.record_dtype,
-                                 use_pallas_rec=args.pallas_rec,
                                  split_markers=args.scheduler == "sync")
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
@@ -289,7 +278,6 @@ def run_worker(args) -> int:
         "repeats": args.repeats,
         "queue_capacity": cfg.queue_capacity,
         "record_dtype": cfg.record_dtype,
-        "use_pallas_rec": cfg.use_pallas_rec,
         "max_recorded": cfg.max_recorded,
         "delay": args.delay,
     }

@@ -97,18 +97,10 @@ def _cmd_storm(args) -> int:
                                      tokens=args.phases + 10),
            "sf": lambda: scale_free(args.nodes, 2, args.seed,
                                     tokens=args.phases + 10)}[args.graph]
-    if args.pallas_rec and args.scheduler != "sync":
-        print("--pallas-rec only affects the sync scheduler", file=sys.stderr)
-        return 2
-    if args.pallas_rec and args.max_recorded % 8:
-        print("--pallas-rec needs --max-recorded divisible by 8 "
-              "(TPU sublane tile)", file=sys.stderr)
-        return 2
     spec = gen()
     cfg = SimConfig.for_workload(
         snapshots=args.snapshots, max_recorded=args.max_recorded,
         record_dtype=args.record_dtype, reduce_mode=args.reduce_mode,
-        use_pallas_rec=args.pallas_rec,
         split_markers=args.scheduler == "sync",
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
@@ -176,7 +168,9 @@ def main(argv=None) -> int:
     ps.add_argument("--queue-capacity", type=int, default=0,
                     help="per-edge ring slots; 0 = size to the workload "
                          "(SimConfig.for_workload)")
-    ps.add_argument("--max-recorded", type=int, default=16)
+    ps.add_argument("--max-recorded", type=int, default=0,
+                    help="per-edge log slots L; 0 = derived "
+                         "(SimConfig.for_workload)")
     ps.add_argument("--record-dtype", choices=["int32", "int16"],
                     default="int32")
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
@@ -185,9 +179,6 @@ def main(argv=None) -> int:
                     default="hash",
                     help="fast-path delay sampler (same default as bench "
                          "--delay)")
-    ps.add_argument("--pallas-rec", action="store_true",
-                    help="Pallas block-skipping recorded-message append "
-                         "(sync scheduler only)")
     ps.add_argument("--checkpoint", help="save final state to this .npz")
     ps.set_defaults(fn=_cmd_storm)
 

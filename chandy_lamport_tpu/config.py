@@ -31,13 +31,19 @@ class SimConfig:
 
     queue_capacity: int = 16       # per-edge ring buffer slots (C)
     max_snapshots: int = 16        # concurrent snapshot slots (S)
-    max_recorded: int = 32         # recorded messages per (snapshot, edge) (M)
+    # Per-edge recorded-arrival LOG slots (L). Recording is stored as ONE
+    # shared append log per edge (``log_amt[L, E]``) plus per-(snapshot,
+    # edge) window counters — every slot recording an edge records the
+    # same arrival stream, and each (s, e) records a contiguous window of
+    # it, so the log carries the union of all windows instead of S
+    # separate [M] buffers. L bounds recorded arrivals per edge across
+    # ALL still-undecoded windows (ERR_RECORD_OVERFLOW past it).
+    max_recorded: int = 32
     max_delay: int = MAX_DELAY
     max_ticks: int = 100_000       # drain-loop budget (guards non-strongly-connected graphs)
-    # dtype of the recorded-message buffer rec_data[S, M, E] — the dominant
-    # per-instance HBM term (utils/metrics.instance_footprint_bytes). int16
-    # halves it and roughly doubles the max batch; amounts beyond the dtype's
-    # range fire ERR_VALUE_OVERFLOW instead of truncating silently.
+    # dtype of the per-edge arrival log ``log_amt[L, E]``; int16 halves it
+    # and roughly doubles the max batch; amounts beyond the dtype's range
+    # fire ERR_VALUE_OVERFLOW instead of truncating silently.
     record_dtype: str = "int32"
     # dtype for 0/1 COUNT incidence matmuls (ops/tick.count_dtype): "auto"
     # picks bf16 on TPU when the degree bound proves counts exact (<= 256),
@@ -53,11 +59,6 @@ class SimConfig:
     # "segsum" uses O(E) integer prefix-sum segment reductions (exact at
     # any scale, no large constants). "auto" picks by graph size.
     reduce_mode: str = "auto"
-    # Use the Pallas block-skipping kernel (ops/pallas_rec.py) for the
-    # recorded-message append in the sync tick: clean [tile, M] blocks of
-    # rec_data move zero HBM bytes instead of being rewritten every tick.
-    # Opt-in: TPU (compiled) or any backend (interpret mode, used by CI).
-    use_pallas_rec: bool = False
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
@@ -111,6 +112,14 @@ class SimConfig:
                     + sends_per_edge_per_phase * (max_delay + 1))
         c = max(16, analytic + hol_slack)
         overrides.setdefault("max_snapshots", max(8, snapshots))
+        # per-edge log capacity: the union of all snapshots' recording
+        # windows on one edge — bounded by (window span ~ marker transit)
+        # x send rate, summed over staggered snapshots when windows are
+        # disjoint; 4 slots per snapshot with a floor of 32 covers every
+        # measured workload, and ERR_RECORD_OVERFLOW + the bench's
+        # doubling retry keep any shortfall honest
+        if not overrides.get("max_recorded"):
+            overrides["max_recorded"] = max(32, 4 * snapshots)
         # an explicit queue_capacity override wins over the derived size
         capacity = overrides.pop("queue_capacity", (c + 7) // 8 * 8)
         return cls(queue_capacity=capacity, max_delay=max_delay, **overrides)

@@ -22,12 +22,11 @@ State encoding (the jit carry; one instance — batching vmaps the whole tuple):
   - snapshot slot s holds snapshot id s (ids are allocated sequentially from
     0, reference sim.go:107-108, so slot==id while id < S);
   - ``recording[S, E]`` replaces per-snapshot ``isLinkRecording`` maps
-    (node.go:39); ``rec_data[S, M, E]`` + ``rec_len[S, E]`` replace the
-    ``incomingMessages`` lists (node.go:38) — only token amounts are stored
-    because only non-marker messages are ever recorded (node.go:174-185).
-    The edge axis is minor (E in vector lanes, M on sublanes): M is small
-    (16 default), so an M-minor layout would waste 7/8 of each register
-    and is un-DMA-able by the Pallas rec kernel (ops/pallas_rec.py);
+    (node.go:39); the ``incomingMessages`` lists (node.go:38) become ONE
+    shared per-edge arrival log plus per-(snapshot, edge) window counters
+    (see the "Recording as windows" paragraph below) — only token amounts
+    are stored because only non-marker messages are ever recorded
+    (node.go:174-185);
   - ``completed[S]`` replaces the per-snapshot WaitGroup (sim.go:17);
   - ``error`` is a sticky bitmask replacing Go's log.Fatal / unbounded growth
     (checked on the host after a run; SURVEY.md §5 "sanitizer" equivalent).
@@ -119,7 +118,23 @@ class DenseTopology:
 
 class DenseState(NamedTuple):
     """The jit carry. Shapes: N nodes, E edges, C queue slots, S snapshot
-    slots, M recorded messages per (snapshot, edge).
+    slots, L recorded-arrival log slots per edge.
+
+    **Recording as windows.** HandleToken (node.go:174-185) appends the
+    arriving amount to EVERY snapshot still recording the channel — i.e.
+    all recording slots observe the same per-edge arrival stream, and each
+    (s, e) records exactly the arrivals between its recording start
+    (CreateLocalSnapshot) and stop (marker receipt): a contiguous window
+    of that stream. So instead of S separate [M] buffers rewritten by a
+    dense [S, M, E] masked select every tick (the former top line of the
+    device profile at 5.2 ms/tick), recording is ONE ring log per edge —
+    ``log_amt[L, E]`` appended at ``rec_cnt % L`` — plus window counters
+    ``rec_start/rec_end[S, E]`` (in ``rec_cnt`` units) and prefix sums
+    ``rec_sum0/rec_sum1`` snapshotting ``rec_sum`` for O(1) conservation
+    checks. Appends happen only while at least one slot records the edge,
+    so L bounds the union of all windows; overwriting an undecoded
+    window's data (``rec_cnt - min_prot > L``, where ``min_prot`` is the
+    earliest window start on the edge) fires ERR_RECORD_OVERFLOW.
 
     Channel state exists in two representations, selected by the kernel's
     ``marker_mode`` (ops/tick.TickKernel):
@@ -159,8 +174,14 @@ class DenseState(NamedTuple):
     rem: Any           # i32 [S, N]   links still being recorded
     done_local: Any    # bool [S, N]
     recording: Any     # bool [S, E]
-    rec_len: Any       # i32 [S, E]
-    rec_data: Any      # i32 [S, M, E]
+    rec_cnt: Any       # i32 [E]     arrivals ever appended to the edge log
+    rec_sum: Any       # i32 [E]     cumulative appended amounts
+    min_prot: Any      # i32 [E]     earliest window start (BIG = none yet)
+    log_amt: Any       # i32 [L, E]  per-edge ring log of recorded amounts
+    rec_start: Any     # i32 [S, E]  rec_cnt at recording start
+    rec_end: Any       # i32 [S, E]  rec_cnt at recording stop
+    rec_sum0: Any      # i32 [S, E]  rec_sum at recording start
+    rec_sum1: Any      # i32 [S, E]  rec_sum at recording stop
     completed: Any     # i32 [S]      nodes finalized for this snapshot
     delay_state: Any   # sampler-specific pytree
     error: Any         # i32 [] sticky bitmask
@@ -191,8 +212,14 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         rem=np.zeros((s, n), i32),
         done_local=np.zeros((s, n), b),
         recording=np.zeros((s, e), b),
-        rec_len=np.zeros((s, e), i32),
-        rec_data=np.zeros((s, m, e), np.dtype(cfg.record_dtype)),
+        rec_cnt=np.zeros(e, i32),
+        rec_sum=np.zeros(e, i32),
+        min_prot=np.full(e, np.iinfo(np.int32).max, i32),
+        log_amt=np.zeros((m, e), np.dtype(cfg.record_dtype)),
+        rec_start=np.zeros((s, e), i32),
+        rec_end=np.zeros((s, e), i32),
+        rec_sum0=np.zeros((s, e), i32),
+        rec_sum1=np.zeros((s, e), i32),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
         error=np.int32(0),
@@ -203,16 +230,23 @@ def decode_snapshot(topo: DenseTopology, host: DenseState, sid: int) -> GlobalSn
     """Array state -> GlobalSnapshot, the reference's CollectSnapshot
     (sim.go:134-173) as a pure gather: token map from the frozen balances,
     messages per node over its inbound edges in src-rank order, each edge's
-    recordings in arrival order (golden-compatible, test_common.go:253-284)."""
+    recordings in arrival order (golden-compatible, test_common.go:253-284).
+    An edge's recorded messages are its window [rec_start, rec_end) of the
+    per-edge arrival log (rec_end falls back to the live rec_cnt for a
+    still-recording channel of an incomplete snapshot)."""
     token_map = {nid: int(host.frozen[sid, i]) for i, nid in enumerate(topo.ids)}
+    lcap = host.log_amt.shape[-2]
     messages: List[MsgSnapshot] = []
     for nidx, nid in enumerate(topo.ids):
         for eidx in topo.in_edges[nidx]:
             src = topo.ids[int(topo.edge_src[eidx])]
-            for j in range(int(host.rec_len[sid, eidx])):
+            start = int(host.rec_start[sid, eidx])
+            end = (int(host.rec_cnt[eidx]) if host.recording[sid, eidx]
+                   else int(host.rec_end[sid, eidx]))
+            for j in range(start, end):
                 messages.append(MsgSnapshot(
                     src, nid, Message(is_marker=False,
-                                      data=int(host.rec_data[sid, j, eidx]))))
+                                      data=int(host.log_amt[j % lcap, eidx]))))
     return GlobalSnapshot(sid, token_map, messages)
 
 

@@ -93,6 +93,48 @@ def count_dtype(topo: DenseTopology, override: str = "auto",
     return jnp.float32
 
 
+def log_append(log_amt, rec_cnt, rec_sum, min_prot, recording, tok_e, amt_e,
+               rec_dtype, rec_limit, log_slots: int):
+    """Shared-log append for one sync tick, vector form (DenseState
+    "Recording as windows"): append ``amt_e[e]`` to edge e's ring log when
+    a token delivered there (``tok_e``) and ANY slot records it. One
+    definition serves both the dense and the graph-sharded sync tick so
+    the two cannot drift. Returns (log_amt, rec_cnt, rec_sum, err_bits);
+    the caller folds err_bits into its error channel (psum'd on the
+    sharded path)."""
+    app_e = tok_e & jnp.any(recording, axis=-2)
+    pos_e = rec_cnt % log_slots
+    ll = jnp.arange(log_slots, dtype=_i32)[:, None]
+    new_cnt = rec_cnt + app_e.astype(_i32)
+    err = (jnp.any(app_e & (new_cnt - min_prot > log_slots)).astype(_i32)
+           * ERR_RECORD_OVERFLOW
+           | jnp.any(app_e & (amt_e > rec_limit)).astype(_i32)
+           * ERR_VALUE_OVERFLOW)
+    log_amt = jnp.where(app_e[None, :] & (ll == pos_e[None, :]),
+                        amt_e[None, :].astype(rec_dtype), log_amt)
+    return log_amt, new_cnt, rec_sum + jnp.where(app_e, amt_e, 0), err
+
+
+def window_update(s, started_se, stopped_se, rec_cnt, rec_sum):
+    """Open/close recording windows at the given (post-append) counters:
+    replaces rec_start/rec_sum0 where ``started_se``, rec_end/rec_sum1
+    where ``stopped_se`` (pass None for start-only injection paths), and
+    advances min_prot. Shared by the dense and sharded kernels; returns
+    the field dict for ``state._replace``."""
+    cnt_b = jnp.expand_dims(rec_cnt, -2)
+    sum_b = jnp.expand_dims(rec_sum, -2)
+    out = dict(
+        rec_start=jnp.where(started_se, cnt_b, s.rec_start),
+        rec_sum0=jnp.where(started_se, sum_b, s.rec_sum0),
+        min_prot=jnp.where(jnp.any(started_se, axis=-2),
+                           jnp.minimum(s.min_prot, rec_cnt), s.min_prot),
+    )
+    if stopped_se is not None:
+        out.update(rec_end=jnp.where(stopped_se, cnt_b, s.rec_end),
+                   rec_sum1=jnp.where(stopped_se, sum_b, s.rec_sum1))
+    return out
+
+
 class TickKernel:
     """Jitted closures over a fixed (topology, config, delay sampler).
 
@@ -178,7 +220,7 @@ class TickKernel:
                             else jnp.asarray(a_in, self._cnt))
             self._A_out_c = jnp.asarray(a_out, self._cnt)
         # recorded amounts beyond the record dtype's range must flag, not
-        # silently truncate (record_dtype shrinks rec_data[S, M, E] HBM)
+        # silently truncate (record_dtype shrinks the log_amt[L, E] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
         self.tick = jax.jit(self._tick, donate_argnums=0)
@@ -279,6 +321,14 @@ class TickKernel:
             rem=s.rem.at[sid, node].set(links),
             recording=s.recording.at[sid].set(
                 jnp.where(rec_mask, True, s.recording[sid])),
+            # window start: this slot records the edge's arrivals from here
+            rec_start=s.rec_start.at[sid].set(
+                jnp.where(rec_mask, s.rec_cnt, s.rec_start[sid])),
+            rec_sum0=s.rec_sum0.at[sid].set(
+                jnp.where(rec_mask, s.rec_sum, s.rec_sum0[sid])),
+            min_prot=jnp.where(rec_mask,
+                               jnp.minimum(s.min_prot, s.rec_cnt),
+                               s.min_prot),
         )
 
     def _broadcast_markers(self, s: DenseState, node, sid) -> DenseState:
@@ -294,7 +344,7 @@ class TickKernel:
     def _finalize_check(self, s: DenseState, sid, node) -> DenseState:
         """finalizeSnapshot + NotifyCompletedSnapshot when no links remain
         recording (node.go:165-170). The message flattening itself is a
-        decode-time gather — rec_data is already per-edge in arrival order."""
+        decode-time gather — the per-edge log is already in arrival order."""
         fire = (s.has_local[sid, node] & (s.rem[sid, node] == 0)
                 & ~s.done_local[sid, node])
         return s._replace(
@@ -315,37 +365,41 @@ class TickKernel:
             return self._broadcast_markers(s, dst, sid)
 
         def repeat(s):
+            # a repeat marker always finds the channel recording (each id
+            # crosses an edge once; the excluded channel consumed the FIRST
+            # marker) — close the window at the current append counter
             return s._replace(
                 recording=s.recording.at[sid, e].set(False),
                 rem=s.rem.at[sid, dst].add(-1),
+                rec_end=s.rec_end.at[sid, e].set(s.rec_cnt[e]),
+                rec_sum1=s.rec_sum1.at[sid, e].set(s.rec_sum[e]),
             )
 
         s = lax.cond(~s.has_local[sid, dst], first, repeat, s)
         return self._finalize_check(s, sid, dst)
 
     def _handle_token(self, s: DenseState, e, amount) -> DenseState:
-        """HandleToken (node.go:174-185): credit the destination, then append
-        the amount to every snapshot slot still recording this edge —
-        vectorized over all S slots at once."""
-        S, M = self.cfg.max_snapshots, self.cfg.max_recorded
+        """HandleToken (node.go:174-185): credit the destination; if ANY
+        snapshot slot is recording this edge, append the amount once to the
+        edge's shared arrival log — every recording slot's window covers
+        it (DenseState "Recording as windows")."""
+        L = self.cfg.max_recorded
         dst = self._edge_dst[e]
-        cond = s.recording[:, e]                       # [S]
-        pos = jnp.clip(s.rec_len[:, e], 0, M - 1)      # [S]
-        rows = jnp.arange(S)
-        col = s.rec_data[:, :, e]                      # [S, M]
-        amount_r = jnp.asarray(amount, self._rec_dtype)
-        col = col.at[rows, pos].set(
-            jnp.where(cond, amount_r, col[rows, pos]))
-        err = s.error | jnp.where(
-            jnp.any(cond & (s.rec_len[:, e] >= M)), ERR_RECORD_OVERFLOW, 0
-        ).astype(_i32)
-        err = err | jnp.where(
-            jnp.any(cond) & (jnp.asarray(amount, _i32) > self._rec_limit),
-            ERR_VALUE_OVERFLOW, 0).astype(_i32)
+        rec = jnp.any(s.recording[:, e])
+        pos = s.rec_cnt[e] % L
+        amount_i = jnp.asarray(amount, _i32)
+        new_cnt = s.rec_cnt[e] + jnp.asarray(rec, _i32)
+        err = s.error | jnp.where(rec & (new_cnt - s.min_prot[e] > L),
+                                  ERR_RECORD_OVERFLOW, 0).astype(_i32)
+        err = err | jnp.where(rec & (amount_i > self._rec_limit),
+                              ERR_VALUE_OVERFLOW, 0).astype(_i32)
         return s._replace(
-            tokens=s.tokens.at[dst].add(jnp.asarray(amount, _i32)),
-            rec_data=s.rec_data.at[:, :, e].set(col),
-            rec_len=s.rec_len.at[:, e].add(cond.astype(_i32)),
+            tokens=s.tokens.at[dst].add(amount_i),
+            log_amt=s.log_amt.at[pos, e].set(
+                jnp.where(rec, jnp.asarray(amount, self._rec_dtype),
+                          s.log_amt[pos, e])),
+            rec_cnt=s.rec_cnt.at[e].set(new_cnt),
+            rec_sum=s.rec_sum.at[e].add(jnp.where(rec, amount_i, 0)),
             error=err,
         )
 
@@ -462,33 +516,14 @@ class TickKernel:
         s = s._replace(
             tokens=s.tokens + credit,
             error=s.error | jnp.where(toobig, ERR_VALUE_OVERFLOW, 0).astype(_i32))
-        rec_mask = s.recording & tok_e[None, :]                   # [S, E]
-        err = s.error | jnp.where(jnp.any(rec_mask & (s.rec_len >= M)),
-                                  ERR_RECORD_OVERFLOW, 0).astype(_i32)
-        err = err | jnp.where(
-            jnp.any(rec_mask & (amt_e > self._rec_limit)[None, :]),
-            ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        if self.cfg.use_pallas_rec:
-            # block-skipping Pallas append: clean [tile, M] blocks of
-            # rec_data move zero HBM bytes (ops/pallas_rec.py); compiled on
-            # TPU, interpreted elsewhere (CI runs the interpret path)
-            from chandy_lamport_tpu.ops import pallas_rec
-
-            rec_data = pallas_rec.rec_append(
-                s.rec_data, s.rec_len, rec_mask, amt_e,
-                interpret=jax.default_backend() != "tpu")
-        else:
-            # the same formulation the kernel tests use as ground truth —
-            # one definition so the two paths cannot drift
-            from chandy_lamport_tpu.ops.pallas_rec import rec_append_reference
-
-            rec_data = rec_append_reference(s.rec_data, s.rec_len, rec_mask,
-                                            amt_e)
-        s = s._replace(
-            rec_data=rec_data,
-            rec_len=s.rec_len + rec_mask.astype(_i32),
-            error=err,
-        )
+        # shared-log append (DenseState "Recording as windows"): one [L, E]
+        # one-hot write instead of the former dense [S, M, E] rewrite (the
+        # top line of the device profile at 5.2 ms/tick, 8x this write)
+        log, cnt, sm, err_bits = log_append(
+            s.log_amt, s.rec_cnt, s.rec_sum, s.min_prot, s.recording,
+            tok_e, amt_e, self._rec_dtype, self._rec_limit, M)
+        s = s._replace(log_amt=log, rec_cnt=cnt, rec_sum=sm,
+                       error=s.error | err_bits)
 
         # ---- marker deliveries, all snapshot slots at once (HandleMarker,
         # node.go:149-171). The consumed marker per delivering edge is its
@@ -502,15 +537,21 @@ class TickKernel:
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
         created_dst_se = self._spread_dst(created)                 # [S, E]
+        stopped = mk_se & s.recording                              # [S, E]
+        started_se = created_dst_se & ~mk_se                       # [S, E]
         recording = (s.recording | created_dst_se) & ~mk_se
         rem = jnp.where(created, self._in_degree[None, :] - arrivals,
                         s.rem - jnp.where(had, arrivals, 0))
         has_local = had | created
+        # window open/close at the POST-append counters (tokens deliver
+        # before markers within the tick, and a delivering edge carries
+        # either a token or a marker, never both)
         s = s._replace(
             recording=recording,
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=rem,
             has_local=has_local,
+            **window_update(s, started_se, stopped, s.rec_cnt, s.rec_sum),
         )
 
         # ---- re-broadcast from every node that just created its local
@@ -635,6 +676,7 @@ class TickKernel:
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=jnp.where(created, self._in_degree[None, :], s.rem),
             has_local=s.has_local | created,
+            **window_update(s, created_dst_se, None, s.rec_cnt, s.rec_sum),
         )
         push_se = self._spread_src(created)                        # [S, E]
         return self._push_markers_split(s, push_se)

@@ -124,11 +124,6 @@ class BatchedRunner:
                 self.config, max_delay=self.delay.max_delay)
         if scheduler not in ("exact", "sync"):
             raise ValueError(f"unknown scheduler {scheduler!r}")
-        if self.config.use_pallas_rec and scheduler != "sync":
-            # the Pallas append lives only in the sync tick; accepting the
-            # flag here would silently measure the jnp path under a config
-            # that claims otherwise
-            raise ValueError("use_pallas_rec requires scheduler='sync'")
         # sync uses the split marker representation (ring content untouched
         # by ticks); exact needs the unified ring for push-order PRNG draws
         self.kernel = TickKernel(
@@ -186,8 +181,13 @@ class BatchedRunner:
                 st = jax.tree_util.tree_map(
                     lambda x: jnp.zeros((self.batch,) + np.shape(x),
                                         np.asarray(x).dtype), template)
-                st = st._replace(tokens=jnp.broadcast_to(
-                    tokens0, (self.batch,) + tokens0.shape))
+                st = st._replace(
+                    tokens=jnp.broadcast_to(
+                        tokens0, (self.batch,) + tokens0.shape),
+                    # the one non-zero init beside tokens: "no protected
+                    # window yet" is encoded as int32 max (state.init_state)
+                    min_prot=jnp.full_like(st.min_prot,
+                                           jnp.iinfo(jnp.int32).max))
                 return st._replace(delay_state=self._batched_delay_state())
 
             # cached: a fresh jit closure per call would retrace every time

@@ -52,6 +52,7 @@ from chandy_lamport_tpu.core.state import (
     DenseTopology,
 )
 from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+from chandy_lamport_tpu.ops.tick import log_append, window_update
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 
 _i32 = jnp.int32
@@ -121,8 +122,17 @@ class ShardedState(NamedTuple):
     rem: Any         # i32 [P, S, Nl]
     done_local: Any  # bool [P, S, Nl]
     recording: Any   # bool [P, S, Em]
-    rec_len: Any     # i32 [P, S, Em]
-    rec_data: Any    # i32 [P, S, M, Em] (edge axis minor, as in DenseState)
+    # shared per-edge recording log + per-(slot, edge) windows — the same
+    # representation as DenseState ("Recording as windows"); everything is
+    # edge-local, so it shards cleanly with the edges
+    rec_cnt: Any     # i32 [P, Em]
+    rec_sum: Any     # i32 [P, Em]
+    min_prot: Any    # i32 [P, Em]
+    log_amt: Any     # i32 [P, L, Em]
+    rec_start: Any   # i32 [P, S, Em]
+    rec_end: Any     # i32 [P, S, Em]
+    rec_sum0: Any    # i32 [P, S, Em]
+    rec_sum1: Any    # i32 [P, S, Em]
     completed: Any   # i32 [S] (replicated)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
@@ -188,12 +198,6 @@ class GraphShardedRunner:
         unsharded kernel (counter-based streams differ by construction)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
-        if self.config.use_pallas_rec:
-            # not wired through shard_map yet — reject rather than silently
-            # measuring the dense jnp append under a config that claims
-            # otherwise (the dense BatchedRunner honors the flag)
-            raise ValueError(
-                "use_pallas_rec is not supported by GraphShardedRunner")
         self.mesh = mesh
         self.axis = axis
         self.shards = mesh.shape[axis]
@@ -203,7 +207,7 @@ class GraphShardedRunner:
         if self.config.max_delay != self.max_delay:
             self.config = dataclasses.replace(self.config,
                                               max_delay=self.max_delay)
-        # shared numeric-exactness gate with TickKernel (ops/tick.count_dtype)
+        # shared numeric-exactness gate + recording helpers with TickKernel
         from chandy_lamport_tpu.ops.tick import count_dtype
 
         self._cnt = count_dtype(self.topo, self.config.count_dtype)
@@ -236,7 +240,10 @@ class GraphShardedRunner:
             next_sid=spec_rep, started=spec_rep,
             has_local=spec_sharded, frozen=spec_sharded, rem=spec_sharded,
             done_local=spec_sharded, recording=spec_sharded,
-            rec_len=spec_sharded, rec_data=spec_sharded, completed=spec_rep,
+            rec_cnt=spec_sharded, rec_sum=spec_sharded,
+            min_prot=spec_sharded, log_amt=spec_sharded,
+            rec_start=spec_sharded, rec_end=spec_sharded,
+            rec_sum0=spec_sharded, rec_sum1=spec_sharded, completed=spec_rep,
             delay_key=spec_sharded, error=spec_rep)
         self._state_specs = state_specs
 
@@ -286,8 +293,14 @@ class GraphShardedRunner:
             rem=np.zeros((p, s, nl), np.int32),
             done_local=np.zeros((p, s, nl), np.bool_),
             recording=np.zeros((p, s, em), np.bool_),
-            rec_len=np.zeros((p, s, em), np.int32),
-            rec_data=np.zeros((p, s, m, em), np.dtype(self.config.record_dtype)),
+            rec_cnt=np.zeros((p, em), np.int32),
+            rec_sum=np.zeros((p, em), np.int32),
+            min_prot=np.full((p, em), np.iinfo(np.int32).max, np.int32),
+            log_amt=np.zeros((p, m, em), np.dtype(self.config.record_dtype)),
+            rec_start=np.zeros((p, s, em), np.int32),
+            rec_end=np.zeros((p, s, em), np.int32),
+            rec_sum0=np.zeros((p, s, em), np.int32),
+            rec_sum1=np.zeros((p, s, em), np.int32),
             completed=np.zeros(s, np.int32),
             delay_key=keys,
             error=np.int32(0),
@@ -397,6 +410,7 @@ class GraphShardedRunner:
             rem=jnp.where(created_l,
                           self._my_slice(st.in_degree[None, :]), s.rem),
             has_local=s.has_local | created_l,
+            **window_update(s, created_dst_se, None, s.rec_cnt, s.rec_sum),
         )
         push_se = (created_f @ st.a_src_c) > 0.5  # [S, Em]
         return self._push_markers_split(s, st, push_se)
@@ -540,19 +554,13 @@ class GraphShardedRunner:
             tokens=s.tokens
             + self._my_slice(credit_n[None, :])[0].astype(_i32),
             error=s.error | self._por(inexact * ERR_VALUE_OVERFLOW))
-        rec_mask = s.recording & tok[None, :]
-        err_local = (jnp.any(rec_mask & (s.rec_len >= M)).astype(_i32)
-                     * ERR_RECORD_OVERFLOW
-                     | jnp.any(rec_mask & (amt > self._rec_limit)[None, :])
-                     .astype(_i32) * ERR_VALUE_OVERFLOW)
-        from chandy_lamport_tpu.ops.pallas_rec import rec_append_reference
-
-        s = s._replace(
-            rec_data=rec_append_reference(s.rec_data, s.rec_len, rec_mask,
-                                          amt),
-            rec_len=s.rec_len + rec_mask.astype(_i32),
-            error=s.error | self._por(err_local),
-        )
+        # shared-log append, shard-local (one definition with the dense
+        # kernel: ops/tick.log_append); the error bits psum across shards
+        log, cnt, sm, err_bits = log_append(
+            s.log_amt, s.rec_cnt, s.rec_sum, s.min_prot, s.recording,
+            tok, amt, self._rec_dtype, self._rec_limit, M)
+        s = s._replace(log_amt=log, rec_cnt=cnt, rec_sum=sm,
+                       error=s.error | self._por(err_bits))
 
         # markers: the consumed marker per delivering edge is its front
         # pending entry (plane index == snapshot id); arrivals via psum,
@@ -568,6 +576,8 @@ class GraphShardedRunner:
                                    tiled=True)                 # [S, N]
         created_f = created_n.astype(self._cnt)
         created_dst_se = (created_f @ st.a_in_c) > 0.5
+        stopped = mk_se & s.recording                           # [S, Em]
+        started_se = created_dst_se & ~mk_se
         s = s._replace(
             recording=(s.recording | created_dst_se) & ~mk_se,
             frozen=jnp.where(created_l, s.tokens[None, :], s.frozen),
@@ -575,6 +585,7 @@ class GraphShardedRunner:
                           self._my_slice(st.in_degree[None, :]) - arrivals_l,
                           s.rem - jnp.where(had_l, arrivals_l, 0)),
             has_local=had_l | created_l,
+            **window_update(s, started_se, stopped, s.rec_cnt, s.rec_sum),
         )
         push_se = (created_f @ st.a_src_c) > 0.5
         s = self._push_markers_split(s, st, push_se)
@@ -788,8 +799,8 @@ class GraphShardedRunner:
         def slot_edges(x):  # [P, S, Em] -> [S, E]
             return np.moveaxis(np.asarray(x)[es, :, el], 1, 0)
 
-        def slot_m_edges(x):  # [P, S, M, Em] -> [S, M, E]
-            return np.moveaxis(np.asarray(x)[es, :, :, el], 0, -1)
+        def log_edges(x):  # [P, L, Em] -> [L, E]
+            return np.moveaxis(np.asarray(x)[es, :, el], 1, 0)
 
         return DenseState(
             time=np.asarray(h.time),
@@ -814,8 +825,14 @@ class GraphShardedRunner:
             rem=nodes(h.rem),
             done_local=nodes(h.done_local),
             recording=slot_edges(h.recording),
-            rec_len=slot_edges(h.rec_len),
-            rec_data=slot_m_edges(h.rec_data),
+            rec_cnt=edges(h.rec_cnt),
+            rec_sum=edges(h.rec_sum),
+            min_prot=edges(h.min_prot),
+            log_amt=log_edges(h.log_amt),
+            rec_start=slot_edges(h.rec_start),
+            rec_end=slot_edges(h.rec_end),
+            rec_sum0=slot_edges(h.rec_sum0),
+            rec_sum1=slot_edges(h.rec_sum1),
             completed=np.asarray(h.completed),
             delay_state=(),
             error=np.asarray(h.error),

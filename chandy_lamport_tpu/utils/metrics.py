@@ -70,7 +70,12 @@ def progress_counters(state: DenseState, cfg: SimConfig,
         "snapshots_completed": jnp.sum(complete),
         "snapshots_pending": jnp.sum(started & ~complete),
         "nodes_finalized": jnp.sum(state.done_local),
-        "recorded_messages": jnp.sum(state.rec_len),
+        # per-(slot, edge) recorded count = its window length in the shared
+        # per-edge log (live windows extend to the current append counter)
+        "recorded_messages": jnp.sum(
+            jnp.where(state.recording,
+                      jnp.expand_dims(state.rec_cnt, -2), state.rec_end)
+            - state.rec_start),
         # bitwise OR over instances (jnp.max would drop bits when different
         # lanes carry different error flags)
         "error_bits": or_reduce(state.error),
@@ -82,13 +87,13 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 13·E·C + 12·E + 4·N + S·(1 + 10·N + E·(14 + rec·M))
+    footprint = 13·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + 26·E)
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
+    and L = cfg.max_recorded (shared per-edge log slots).
 
-    Dominant term at bench shapes is the recorded-message buffer
-    ``rec_data[S, M, E]`` (rec·S·E·M) plus the ``[S, E]`` recording and
-    split-marker planes — size S and M to the workload, not to the worst
-    case.
+    Dominant terms at bench shapes are the [S, E] recording/window/marker
+    planes and the per-edge log ``log_amt[L, E]`` — size S and L to the
+    workload, not to the worst case.
     """
     import numpy as np
 
@@ -98,12 +103,14 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     # q_* rings (marker/data/rtime/seq) + head/len/seq_next
     queues = e * c * (1 + 4 + 4 + 4) + e * (4 + 4 + 4)
     nodes = 4 * n                                       # tokens
-    # per slot: started + [S,N] planes + recording/rec_len/rec_data +
-    # split-marker planes m_pending/m_rtime/m_seq
+    # per-edge recording log: rec_cnt/rec_sum/min_prot + log_amt[L, E]
+    rec_log = e * (4 + 4 + 4) + rec * m * e
+    # per slot: started + [S,N] planes + recording + window counters
+    # (start/end/sum0/sum1) + split-marker planes m_pending/m_rtime/m_seq
     snaps = s * (1 + n * (1 + 4 + 4 + 1)
-                 + e * (1 + 4 + rec * m) + e * (1 + 4 + 4))
+                 + e * (1 + 4 * 4) + e * (1 + 4 + 4))
     scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
-    return queues + nodes + snaps + scalars
+    return queues + nodes + rec_log + snaps + scalars
 
 
 def max_batch_estimate(num_nodes: int, num_edges: int, cfg: SimConfig,

@@ -119,8 +119,12 @@ def test_sync_reduce_modes_match_oracle(case, mode, cnt):
             assert oracle.frozen[sid][node] == int(lane.frozen[sid, node])
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            got = [int(lane.rec_data[sid, j, e])
-                   for j in range(int(lane.rec_len[sid, e]))]
+            lcap = lane.log_amt.shape[-2]
+            start = int(lane.rec_start[sid, e])
+            end = (int(lane.rec_cnt[e]) if lane.recording[sid, e]
+                   else int(lane.rec_end[sid, e]))
+            got = [int(lane.log_amt[j % lcap, e])
+                   for j in range(start, end)]
             assert want == got
 
 
@@ -157,7 +161,9 @@ def test_forced_bf16_sharded_matches_f32_unsharded():
 
     assert int(got.error) == 0 == int(ref_final.error)
     for name in ("time", "tokens", "q_len", "has_local", "frozen", "rem",
-                 "recording", "rec_len", "rec_data", "completed"):
+                 "recording", "rec_cnt", "rec_sum", "min_prot", "log_amt",
+                 "rec_start", "rec_end", "rec_sum0", "rec_sum1",
+                 "completed"):
         np.testing.assert_array_equal(
             np.asarray(getattr(got, name)),
             np.asarray(getattr(ref_final, name)), err_msg=name)
@@ -188,7 +194,7 @@ def test_for_workload_sizes_the_bench_config():
         snapshots=8, queue_capacity=48).queue_capacity == 48
     # other overrides pass through
     assert SimConfig.for_workload(
-        snapshots=2, use_pallas_rec=True).use_pallas_rec
+        snapshots=2, record_dtype="int16").record_dtype == "int16"
 
 
 def test_bench_workload_runs_clean_at_derived_capacity():

@@ -142,19 +142,19 @@ def test_batched_error_lanes_reported():
 
 
 def test_record_dtype_int16_halves_footprint_and_guards():
-    """SimConfig.record_dtype='int16' shrinks rec_data (the dominant HBM
-    term) and flags amounts beyond int16 range instead of truncating."""
+    """SimConfig.record_dtype='int16' shrinks the per-edge log and flags
+    amounts beyond int16 range instead of truncating."""
     from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
 
     cfg32, cfg16 = SimConfig(), SimConfig(record_dtype="int16")
     shrink = (instance_footprint_bytes(100, 300, cfg32)
               - instance_footprint_bytes(100, 300, cfg16))
-    assert shrink == 2 * cfg32.max_snapshots * 300 * cfg32.max_recorded
+    assert shrink == 2 * 300 * cfg32.max_recorded
 
     spec = _pair(tokens=100_000)
     runner = BatchedRunner(spec, cfg16, FixedJaxDelay(1), batch=1,
                            scheduler="sync")
-    assert runner.init_batch().rec_data.dtype == np.int16
+    assert runner.init_batch().log_amt.dtype == np.int16
     script = compile_events(runner.topo, [
         SnapshotEvent("N2"),                      # records N1->N2
         PassTokenEvent("N1", "N2", 40_000),       # > int16 max while recording

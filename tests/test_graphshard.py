@@ -86,16 +86,22 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
         got = np.concatenate(parts, axis=0)
         want = getattr(ref_final, name)[perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    for name in ("recording", "rec_len", "m_pending", "m_rtime", "m_seq"):
+    for name in ("recording", "rec_start", "rec_end", "rec_sum0",
+                 "rec_sum1", "m_pending", "m_rtime", "m_seq"):
         parts = [getattr(final, name)[p][:, :counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=1)
         want = getattr(ref_final, name)[:, perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    # rec_data's edge axis is minor: [S, M, Em]
-    parts = [final.rec_data[p][:, :, :counts[p]] for p in range(shards)]
-    got = np.concatenate(parts, axis=2)
-    np.testing.assert_array_equal(got, ref_final.rec_data[:, :, perm],
-                                  err_msg="rec_data")
+    for name in ("rec_cnt", "rec_sum", "min_prot"):
+        parts = [getattr(final, name)[p][:counts[p]] for p in range(shards)]
+        got = np.concatenate(parts, axis=0)
+        np.testing.assert_array_equal(got, getattr(ref_final, name)[perm],
+                                      err_msg=name)
+    # the shared per-edge log: [L, Em] per shard
+    parts = [final.log_amt[p][:, :counts[p]] for p in range(shards)]
+    got = np.concatenate(parts, axis=1)
+    np.testing.assert_array_equal(got, ref_final.log_amt[:, perm],
+                                  err_msg="log_amt")
 
 
 def test_sharded_uniform_stream_invariants():
@@ -117,8 +123,9 @@ def test_sharded_uniform_stream_invariants():
             [final.frozen[p][sid] for p in range(4)]).sum())
         recorded = 0
         for p in range(4):
-            for j in range(final.rec_len.shape[-1]):
-                recorded += int(final.rec_data[p][sid,
-                                                  :final.rec_len[p][sid, j],
-                                                  j].sum())
+            # window sums via the rec_sum prefix snapshots (live windows
+            # extend to the current cumulative sum)
+            end_sum = np.where(final.recording[p][sid], final.rec_sum[p],
+                               final.rec_sum1[p][sid])
+            recorded += int((end_sum - final.rec_sum0[p][sid]).sum())
         assert frozen + recorded == int(gs.topo.tokens0.sum())

@@ -61,7 +61,9 @@ def test_script_sharded_matches_unsharded(top, events, shards):
     for name in ("time", "tokens", "q_marker", "q_data", "q_rtime", "q_head",
                  "q_len", "q_seq", "seq_next", "m_pending", "m_rtime",
                  "m_seq", "next_sid", "started", "has_local", "frozen", "rem",
-                 "done_local", "recording", "rec_len", "rec_data", "completed"):
+                 "done_local", "recording", "rec_cnt", "rec_sum", "min_prot",
+                 "log_amt", "rec_start", "rec_end", "rec_sum0", "rec_sum1",
+                 "completed"):
         np.testing.assert_array_equal(
             np.asarray(getattr(got, name)),
             np.asarray(getattr(ref_final, name)), err_msg=name)
@@ -134,8 +136,8 @@ def test_combined_data_graph_lanes_match_single_instance():
         combined.init_batch(batch), np.asarray(prog.amounts),
         np.asarray(prog.snap)))
 
-    for name in ("time", "tokens", "q_len", "frozen", "rec_len", "rec_data",
-                 "completed", "error", "next_sid"):
+    for name in ("time", "tokens", "q_len", "frozen", "rec_cnt", "log_amt",
+                 "rec_start", "rec_end", "completed", "error", "next_sid"):
         want = np.asarray(getattr(ref, name))
         got = np.asarray(getattr(final, name))
         assert got.shape == (batch,) + want.shape, name

@@ -93,6 +93,10 @@ def test_dense_sync_matches_oracle(case):
         # recorded channel contents, per edge in arrival order
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            got = [int(lane.rec_data[sid, j, e])
-                   for j in range(int(lane.rec_len[sid, e]))]
+            lcap = lane.log_amt.shape[-2]
+            start = int(lane.rec_start[sid, e])
+            end = (int(lane.rec_cnt[e]) if lane.recording[sid, e]
+                   else int(lane.rec_end[sid, e]))
+            got = [int(lane.log_amt[j % lcap, e])
+                   for j in range(start, end)]
             assert want == got, f"sid {sid} edge {e}"

@@ -63,7 +63,6 @@ def main() -> None:
     p.add_argument("--snapshots", type=int, default=8)
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="same knob as bench --delay")
-    p.add_argument("--pallas-rec", action="store_true")
     p.add_argument("--out", default="/tmp/tickprof")
     p.add_argument("--top", type=int, default=18)
     args = p.parse_args()
@@ -81,7 +80,6 @@ def main() -> None:
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
                                  record_dtype="int16",
                                  reduce_mode=args.reduce_mode,
-                                 use_pallas_rec=args.pallas_rec,
                                  split_markers=True)
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
                            cfg, make_fast_delay(args.delay, 17),

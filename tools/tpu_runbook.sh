@@ -2,7 +2,7 @@
 # One-shot TPU measurement pipeline for a round: run when the device tunnel
 # is up. Appends everything to /tmp/runbook_out/ and BASELINE_MEASURED.jsonl.
 #
-#   1. headline bench A/B: jnp rec path vs --pallas-rec
+#   1. headline bench (hash delay, derived capacities)
 #   2. op-level tick profile (tools/profile_tick.py)
 #   3. the BASELINE.md config ladder, sync + exact schedulers
 #   4. max-batch probe at the 1M-instance north-star config (ring-10)
@@ -14,17 +14,12 @@ OUT="${1:-/tmp/runbook_out}"
 mkdir -p "$OUT"
 cd "$ROOT"
 
-echo "=== 1a. bench (jnp rec path) ==="
+echo "=== 1. bench ==="
 # inner --timeout < outer timeout, so bench's own multi-attempt fallback
 # chain (hang watchdog -> auto -> cpu) can actually run
 timeout 1200 python bench.py --repeats 2 --timeout 300 \
     2>"$OUT/bench_plain.err" | tee "$OUT/bench_plain.json"
 tail -5 "$OUT/bench_plain.err"
-
-echo "=== 1b. bench (--pallas-rec) ==="
-timeout 1200 python bench.py --repeats 2 --pallas-rec --timeout 300 \
-    2>"$OUT/bench_pallas.err" | tee "$OUT/bench_pallas.json"
-tail -5 "$OUT/bench_pallas.err"
 
 echo "=== 2. tick profile ==="
 timeout 900 python tools/profile_tick.py --out "$OUT/tickprof" \
