@@ -129,9 +129,9 @@ class DenseState(NamedTuple):
     dense [S, M, E] masked select every tick (the former top line of the
     device profile at 5.2 ms/tick), recording is ONE ring log per edge —
     ``log_amt[L, E]`` appended at ``rec_cnt % L`` — plus window counters
-    ``rec_start/rec_end[S, E]`` (in ``rec_cnt`` units) and prefix sums
-    ``rec_sum0/rec_sum1`` snapshotting ``rec_sum`` for O(1) conservation
-    checks. Appends happen only while at least one slot records the edge,
+    ``rec_start/rec_end[S, E]`` (in ``rec_cnt`` units); recorded amounts
+    are read from the log window at decode time, so no per-slot amount
+    state exists. Appends happen only while at least one slot records the edge,
     so L bounds the union of all windows; overwriting an undecoded
     window's data (``rec_cnt - min_prot > L``, where ``min_prot`` is the
     earliest window start on the edge) fires ERR_RECORD_OVERFLOW.
@@ -175,13 +175,10 @@ class DenseState(NamedTuple):
     done_local: Any    # bool [S, N]
     recording: Any     # bool [S, E]
     rec_cnt: Any       # i32 [E]     arrivals ever appended to the edge log
-    rec_sum: Any       # i32 [E]     cumulative appended amounts
     min_prot: Any      # i32 [E]     earliest window start (BIG = none yet)
     log_amt: Any       # i32 [L, E]  per-edge ring log of recorded amounts
     rec_start: Any     # i32 [S, E]  rec_cnt at recording start
     rec_end: Any       # i32 [S, E]  rec_cnt at recording stop
-    rec_sum0: Any      # i32 [S, E]  rec_sum at recording start
-    rec_sum1: Any      # i32 [S, E]  rec_sum at recording stop
     completed: Any     # i32 [S]      nodes finalized for this snapshot
     delay_state: Any   # sampler-specific pytree
     error: Any         # i32 [] sticky bitmask
@@ -213,40 +210,43 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         done_local=np.zeros((s, n), b),
         recording=np.zeros((s, e), b),
         rec_cnt=np.zeros(e, i32),
-        rec_sum=np.zeros(e, i32),
         min_prot=np.full(e, np.iinfo(np.int32).max, i32),
         log_amt=np.zeros((m, e), np.dtype(cfg.record_dtype)),
         rec_start=np.zeros((s, e), i32),
         rec_end=np.zeros((s, e), i32),
-        rec_sum0=np.zeros((s, e), i32),
-        rec_sum1=np.zeros((s, e), i32),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
         error=np.int32(0),
     )
 
 
+def recorded_window(host: DenseState, sid: int, eidx: int) -> List[int]:
+    """The amounts snapshot ``sid`` recorded on edge ``eidx``, in arrival
+    order: the [rec_start, rec_end) window of the edge's ring log
+    (rec_end falls back to the live rec_cnt for a still-recording channel
+    of an incomplete snapshot). THE definition of window decode — used by
+    decode_snapshot and every test oracle comparison."""
+    lcap = host.log_amt.shape[-2]
+    start = int(host.rec_start[sid, eidx])
+    end = (int(host.rec_cnt[eidx]) if host.recording[sid, eidx]
+           else int(host.rec_end[sid, eidx]))
+    return [int(host.log_amt[j % lcap, eidx]) for j in range(start, end)]
+
+
 def decode_snapshot(topo: DenseTopology, host: DenseState, sid: int) -> GlobalSnapshot:
     """Array state -> GlobalSnapshot, the reference's CollectSnapshot
     (sim.go:134-173) as a pure gather: token map from the frozen balances,
     messages per node over its inbound edges in src-rank order, each edge's
-    recordings in arrival order (golden-compatible, test_common.go:253-284).
-    An edge's recorded messages are its window [rec_start, rec_end) of the
-    per-edge arrival log (rec_end falls back to the live rec_cnt for a
-    still-recording channel of an incomplete snapshot)."""
+    recordings in arrival order (golden-compatible, test_common.go:253-284)
+    via ``recorded_window``."""
     token_map = {nid: int(host.frozen[sid, i]) for i, nid in enumerate(topo.ids)}
-    lcap = host.log_amt.shape[-2]
     messages: List[MsgSnapshot] = []
     for nidx, nid in enumerate(topo.ids):
         for eidx in topo.in_edges[nidx]:
             src = topo.ids[int(topo.edge_src[eidx])]
-            start = int(host.rec_start[sid, eidx])
-            end = (int(host.rec_cnt[eidx]) if host.recording[sid, eidx]
-                   else int(host.rec_end[sid, eidx]))
-            for j in range(start, end):
+            for amt in recorded_window(host, sid, eidx):
                 messages.append(MsgSnapshot(
-                    src, nid, Message(is_marker=False,
-                                      data=int(host.log_amt[j % lcap, eidx]))))
+                    src, nid, Message(is_marker=False, data=amt)))
     return GlobalSnapshot(sid, token_map, messages)
 
 

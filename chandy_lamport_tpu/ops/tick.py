@@ -93,15 +93,15 @@ def count_dtype(topo: DenseTopology, override: str = "auto",
     return jnp.float32
 
 
-def log_append(log_amt, rec_cnt, rec_sum, min_prot, recording, tok_e, amt_e,
+def log_append(log_amt, rec_cnt, min_prot, recording, tok_e, amt_e,
                rec_dtype, rec_limit, log_slots: int):
     """Shared-log append for one sync tick, vector form (DenseState
     "Recording as windows"): append ``amt_e[e]`` to edge e's ring log when
     a token delivered there (``tok_e``) and ANY slot records it. One
     definition serves both the dense and the graph-sharded sync tick so
-    the two cannot drift. Returns (log_amt, rec_cnt, rec_sum, err_bits);
-    the caller folds err_bits into its error channel (psum'd on the
-    sharded path)."""
+    the two cannot drift. Returns (log_amt, rec_cnt, err_bits); the
+    caller folds err_bits into its error channel (psum'd on the sharded
+    path)."""
     app_e = tok_e & jnp.any(recording, axis=-2)
     pos_e = rec_cnt % log_slots
     ll = jnp.arange(log_slots, dtype=_i32)[:, None]
@@ -112,26 +112,24 @@ def log_append(log_amt, rec_cnt, rec_sum, min_prot, recording, tok_e, amt_e,
            * ERR_VALUE_OVERFLOW)
     log_amt = jnp.where(app_e[None, :] & (ll == pos_e[None, :]),
                         amt_e[None, :].astype(rec_dtype), log_amt)
-    return log_amt, new_cnt, rec_sum + jnp.where(app_e, amt_e, 0), err
+    return log_amt, new_cnt, err
 
 
-def window_update(s, started_se, stopped_se, rec_cnt, rec_sum):
-    """Open/close recording windows at the given (post-append) counters:
-    replaces rec_start/rec_sum0 where ``started_se``, rec_end/rec_sum1
-    where ``stopped_se`` (pass None for start-only injection paths), and
-    advances min_prot. Shared by the dense and sharded kernels; returns
-    the field dict for ``state._replace``."""
+def window_update(s, started_se, stopped_se, rec_cnt):
+    """Open/close recording windows at the given (post-append) counter:
+    replaces rec_start where ``started_se``, rec_end where ``stopped_se``
+    (pass None for start-only injection paths), and advances min_prot.
+    Shared by the dense and sharded kernels; returns the field dict for
+    ``state._replace``. Recorded amounts need no prefix-sum snapshots:
+    decode reads them straight from the log window."""
     cnt_b = jnp.expand_dims(rec_cnt, -2)
-    sum_b = jnp.expand_dims(rec_sum, -2)
     out = dict(
         rec_start=jnp.where(started_se, cnt_b, s.rec_start),
-        rec_sum0=jnp.where(started_se, sum_b, s.rec_sum0),
         min_prot=jnp.where(jnp.any(started_se, axis=-2),
                            jnp.minimum(s.min_prot, rec_cnt), s.min_prot),
     )
     if stopped_se is not None:
-        out.update(rec_end=jnp.where(stopped_se, cnt_b, s.rec_end),
-                   rec_sum1=jnp.where(stopped_se, sum_b, s.rec_sum1))
+        out.update(rec_end=jnp.where(stopped_se, cnt_b, s.rec_end))
     return out
 
 
@@ -324,8 +322,6 @@ class TickKernel:
             # window start: this slot records the edge's arrivals from here
             rec_start=s.rec_start.at[sid].set(
                 jnp.where(rec_mask, s.rec_cnt, s.rec_start[sid])),
-            rec_sum0=s.rec_sum0.at[sid].set(
-                jnp.where(rec_mask, s.rec_sum, s.rec_sum0[sid])),
             min_prot=jnp.where(rec_mask,
                                jnp.minimum(s.min_prot, s.rec_cnt),
                                s.min_prot),
@@ -372,7 +368,6 @@ class TickKernel:
                 recording=s.recording.at[sid, e].set(False),
                 rem=s.rem.at[sid, dst].add(-1),
                 rec_end=s.rec_end.at[sid, e].set(s.rec_cnt[e]),
-                rec_sum1=s.rec_sum1.at[sid, e].set(s.rec_sum[e]),
             )
 
         s = lax.cond(~s.has_local[sid, dst], first, repeat, s)
@@ -399,7 +394,6 @@ class TickKernel:
                 jnp.where(rec, jnp.asarray(amount, self._rec_dtype),
                           s.log_amt[pos, e])),
             rec_cnt=s.rec_cnt.at[e].set(new_cnt),
-            rec_sum=s.rec_sum.at[e].add(jnp.where(rec, amount_i, 0)),
             error=err,
         )
 
@@ -519,11 +513,10 @@ class TickKernel:
         # shared-log append (DenseState "Recording as windows"): one [L, E]
         # one-hot write instead of the former dense [S, M, E] rewrite (the
         # top line of the device profile at 5.2 ms/tick, 8x this write)
-        log, cnt, sm, err_bits = log_append(
-            s.log_amt, s.rec_cnt, s.rec_sum, s.min_prot, s.recording,
+        log, cnt, err_bits = log_append(
+            s.log_amt, s.rec_cnt, s.min_prot, s.recording,
             tok_e, amt_e, self._rec_dtype, self._rec_limit, M)
-        s = s._replace(log_amt=log, rec_cnt=cnt, rec_sum=sm,
-                       error=s.error | err_bits)
+        s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err_bits)
 
         # ---- marker deliveries, all snapshot slots at once (HandleMarker,
         # node.go:149-171). The consumed marker per delivering edge is its
@@ -551,7 +544,7 @@ class TickKernel:
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=rem,
             has_local=has_local,
-            **window_update(s, started_se, stopped, s.rec_cnt, s.rec_sum),
+            **window_update(s, started_se, stopped, s.rec_cnt),
         )
 
         # ---- re-broadcast from every node that just created its local
@@ -676,7 +669,7 @@ class TickKernel:
             frozen=jnp.where(created, s.tokens[None, :], s.frozen),
             rem=jnp.where(created, self._in_degree[None, :], s.rem),
             has_local=s.has_local | created,
-            **window_update(s, created_dst_se, None, s.rec_cnt, s.rec_sum),
+            **window_update(s, created_dst_se, None, s.rec_cnt),
         )
         push_se = self._spread_src(created)                        # [S, E]
         return self._push_markers_split(s, push_se)

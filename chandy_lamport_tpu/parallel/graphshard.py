@@ -126,13 +126,10 @@ class ShardedState(NamedTuple):
     # representation as DenseState ("Recording as windows"); everything is
     # edge-local, so it shards cleanly with the edges
     rec_cnt: Any     # i32 [P, Em]
-    rec_sum: Any     # i32 [P, Em]
     min_prot: Any    # i32 [P, Em]
     log_amt: Any     # i32 [P, L, Em]
     rec_start: Any   # i32 [P, S, Em]
     rec_end: Any     # i32 [P, S, Em]
-    rec_sum0: Any    # i32 [P, S, Em]
-    rec_sum1: Any    # i32 [P, S, Em]
     completed: Any   # i32 [S] (replicated)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
@@ -240,10 +237,9 @@ class GraphShardedRunner:
             next_sid=spec_rep, started=spec_rep,
             has_local=spec_sharded, frozen=spec_sharded, rem=spec_sharded,
             done_local=spec_sharded, recording=spec_sharded,
-            rec_cnt=spec_sharded, rec_sum=spec_sharded,
+            rec_cnt=spec_sharded,
             min_prot=spec_sharded, log_amt=spec_sharded,
-            rec_start=spec_sharded, rec_end=spec_sharded,
-            rec_sum0=spec_sharded, rec_sum1=spec_sharded, completed=spec_rep,
+            rec_start=spec_sharded, rec_end=spec_sharded, completed=spec_rep,
             delay_key=spec_sharded, error=spec_rep)
         self._state_specs = state_specs
 
@@ -294,13 +290,10 @@ class GraphShardedRunner:
             done_local=np.zeros((p, s, nl), np.bool_),
             recording=np.zeros((p, s, em), np.bool_),
             rec_cnt=np.zeros((p, em), np.int32),
-            rec_sum=np.zeros((p, em), np.int32),
             min_prot=np.full((p, em), np.iinfo(np.int32).max, np.int32),
             log_amt=np.zeros((p, m, em), np.dtype(self.config.record_dtype)),
             rec_start=np.zeros((p, s, em), np.int32),
             rec_end=np.zeros((p, s, em), np.int32),
-            rec_sum0=np.zeros((p, s, em), np.int32),
-            rec_sum1=np.zeros((p, s, em), np.int32),
             completed=np.zeros(s, np.int32),
             delay_key=keys,
             error=np.int32(0),
@@ -410,7 +403,7 @@ class GraphShardedRunner:
             rem=jnp.where(created_l,
                           self._my_slice(st.in_degree[None, :]), s.rem),
             has_local=s.has_local | created_l,
-            **window_update(s, created_dst_se, None, s.rec_cnt, s.rec_sum),
+            **window_update(s, created_dst_se, None, s.rec_cnt),
         )
         push_se = (created_f @ st.a_src_c) > 0.5  # [S, Em]
         return self._push_markers_split(s, st, push_se)
@@ -556,10 +549,10 @@ class GraphShardedRunner:
             error=s.error | self._por(inexact * ERR_VALUE_OVERFLOW))
         # shared-log append, shard-local (one definition with the dense
         # kernel: ops/tick.log_append); the error bits psum across shards
-        log, cnt, sm, err_bits = log_append(
-            s.log_amt, s.rec_cnt, s.rec_sum, s.min_prot, s.recording,
+        log, cnt, err_bits = log_append(
+            s.log_amt, s.rec_cnt, s.min_prot, s.recording,
             tok, amt, self._rec_dtype, self._rec_limit, M)
-        s = s._replace(log_amt=log, rec_cnt=cnt, rec_sum=sm,
+        s = s._replace(log_amt=log, rec_cnt=cnt,
                        error=s.error | self._por(err_bits))
 
         # markers: the consumed marker per delivering edge is its front
@@ -585,7 +578,7 @@ class GraphShardedRunner:
                           self._my_slice(st.in_degree[None, :]) - arrivals_l,
                           s.rem - jnp.where(had_l, arrivals_l, 0)),
             has_local=had_l | created_l,
-            **window_update(s, started_se, stopped, s.rec_cnt, s.rec_sum),
+            **window_update(s, started_se, stopped, s.rec_cnt),
         )
         push_se = (created_f @ st.a_src_c) > 0.5
         s = self._push_markers_split(s, st, push_se)
@@ -826,13 +819,10 @@ class GraphShardedRunner:
             done_local=nodes(h.done_local),
             recording=slot_edges(h.recording),
             rec_cnt=edges(h.rec_cnt),
-            rec_sum=edges(h.rec_sum),
             min_prot=edges(h.min_prot),
             log_amt=log_edges(h.log_amt),
             rec_start=slot_edges(h.rec_start),
             rec_end=slot_edges(h.rec_end),
-            rec_sum0=slot_edges(h.rec_sum0),
-            rec_sum1=slot_edges(h.rec_sum1),
             completed=np.asarray(h.completed),
             delay_state=(),
             error=np.asarray(h.error),

@@ -21,7 +21,7 @@ import numpy as np
 import pytest
 
 from chandy_lamport_tpu.config import SimConfig
-from chandy_lamport_tpu.core.state import DenseTopology
+from chandy_lamport_tpu.core.state import recorded_window, DenseTopology
 from chandy_lamport_tpu.core.syncsim import SyncOracle
 from chandy_lamport_tpu.models.delay import FixedDelay
 from chandy_lamport_tpu.models.workloads import (
@@ -119,12 +119,7 @@ def test_sync_reduce_modes_match_oracle(case, mode, cnt):
             assert oracle.frozen[sid][node] == int(lane.frozen[sid, node])
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            lcap = lane.log_amt.shape[-2]
-            start = int(lane.rec_start[sid, e])
-            end = (int(lane.rec_cnt[e]) if lane.recording[sid, e]
-                   else int(lane.rec_end[sid, e]))
-            got = [int(lane.log_amt[j % lcap, e])
-                   for j in range(start, end)]
+            got = recorded_window(lane, sid, e)
             assert want == got
 
 
@@ -161,9 +156,8 @@ def test_forced_bf16_sharded_matches_f32_unsharded():
 
     assert int(got.error) == 0 == int(ref_final.error)
     for name in ("time", "tokens", "q_len", "has_local", "frozen", "rem",
-                 "recording", "rec_cnt", "rec_sum", "min_prot", "log_amt",
-                 "rec_start", "rec_end", "rec_sum0", "rec_sum1",
-                 "completed"):
+                 "recording", "rec_cnt", "min_prot", "log_amt",
+                 "rec_start", "rec_end", "completed"):
         np.testing.assert_array_equal(
             np.asarray(getattr(got, name)),
             np.asarray(getattr(ref_final, name)), err_msg=name)

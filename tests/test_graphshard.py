@@ -10,6 +10,9 @@ import pytest
 from jax.sharding import Mesh
 
 from chandy_lamport_tpu.config import SimConfig
+from types import SimpleNamespace
+
+from chandy_lamport_tpu.core.state import recorded_window
 from chandy_lamport_tpu.models.workloads import (
     erdos_renyi,
     staggered_snapshots,
@@ -86,13 +89,13 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
         got = np.concatenate(parts, axis=0)
         want = getattr(ref_final, name)[perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    for name in ("recording", "rec_start", "rec_end", "rec_sum0",
-                 "rec_sum1", "m_pending", "m_rtime", "m_seq"):
+    for name in ("recording", "rec_start", "rec_end",
+                 "m_pending", "m_rtime", "m_seq"):
         parts = [getattr(final, name)[p][:, :counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=1)
         want = getattr(ref_final, name)[:, perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
-    for name in ("rec_cnt", "rec_sum", "min_prot"):
+    for name in ("rec_cnt", "min_prot"):
         parts = [getattr(final, name)[p][:counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=0)
         np.testing.assert_array_equal(got, getattr(ref_final, name)[perm],
@@ -123,9 +126,13 @@ def test_sharded_uniform_stream_invariants():
             [final.frozen[p][sid] for p in range(4)]).sum())
         recorded = 0
         for p in range(4):
-            # window sums via the rec_sum prefix snapshots (live windows
-            # extend to the current cumulative sum)
-            end_sum = np.where(final.recording[p][sid], final.rec_sum[p],
-                               final.rec_sum1[p][sid])
-            recorded += int((end_sum - final.rec_sum0[p][sid]).sum())
+            # per-shard view with just the window-decode fields (the
+            # replicated scalars in ShardedState are 0-d, so a full
+            # tree_map slice would fail)
+            shard = SimpleNamespace(
+                log_amt=final.log_amt[p], rec_cnt=final.rec_cnt[p],
+                rec_start=final.rec_start[p], rec_end=final.rec_end[p],
+                recording=final.recording[p])
+            for e in range(shard.rec_start.shape[-1]):
+                recorded += sum(recorded_window(shard, sid, e))
         assert frozen + recorded == int(gs.topo.tokens0.sum())

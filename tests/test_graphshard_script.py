@@ -61,9 +61,8 @@ def test_script_sharded_matches_unsharded(top, events, shards):
     for name in ("time", "tokens", "q_marker", "q_data", "q_rtime", "q_head",
                  "q_len", "q_seq", "seq_next", "m_pending", "m_rtime",
                  "m_seq", "next_sid", "started", "has_local", "frozen", "rem",
-                 "done_local", "recording", "rec_cnt", "rec_sum", "min_prot",
-                 "log_amt", "rec_start", "rec_end", "rec_sum0", "rec_sum1",
-                 "completed"):
+                 "done_local", "recording", "rec_cnt", "min_prot",
+                 "log_amt", "rec_start", "rec_end", "completed"):
         np.testing.assert_array_equal(
             np.asarray(getattr(got, name)),
             np.asarray(getattr(ref_final, name)), err_msg=name)

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from chandy_lamport_tpu.config import SimConfig
-from chandy_lamport_tpu.core.state import DenseTopology, decode_snapshot
+from chandy_lamport_tpu.core.state import recorded_window, DenseTopology, decode_snapshot
 from chandy_lamport_tpu.core.syncsim import SyncOracle
 from chandy_lamport_tpu.models.delay import FixedDelay
 from chandy_lamport_tpu.models.workloads import (
@@ -93,10 +93,5 @@ def test_dense_sync_matches_oracle(case):
         # recorded channel contents, per edge in arrival order
         for e in range(topo.e):
             want = oracle.recorded[sid].get(e, [])
-            lcap = lane.log_amt.shape[-2]
-            start = int(lane.rec_start[sid, e])
-            end = (int(lane.rec_cnt[e]) if lane.recording[sid, e]
-                   else int(lane.rec_end[sid, e]))
-            got = [int(lane.log_amt[j % lcap, e])
-                   for j in range(start, end)]
+            got = recorded_window(lane, sid, e)
             assert want == got, f"sid {sid} edge {e}"
