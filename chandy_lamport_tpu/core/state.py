@@ -146,13 +146,20 @@ class DenseState(NamedTuple):
       markers — of which each (snapshot, edge) pair ever holds at most ONE
       (a node broadcasts an id only on first receipt, node.go:154-156) —
       live in the dense ``m_*[S, E]`` planes. Per-channel FIFO order
-      between the two is preserved by the monotone per-edge sequence
-      numbers ``q_seq``/``m_seq`` (allocated from ``seq_next`` at push
-      time): the channel's front is the live item with the smallest
-      sequence number, and head-of-line blocking applies to that front.
-      The win: ring CONTENT is then written only when tokens are sent
-      (per storm phase), not on every tick's marker traffic — the dense
-      per-tick [E, C] rewrite was >50% of sync-tick time on TPU.
+      between the two needs no per-slot sequence plane: tokens among
+      themselves are ordered by the ring itself, so a marker's position
+      is fully described by ``m_key = tokens_pushed_before * KEYMULT +
+      marker_ord`` (``tok_pushed``/``mk_cnt`` counters at push time;
+      KEYMULT = next power of two above max_snapshots, so keys are
+      unique per edge and sorted by push order). The marker with the
+      smallest key is the marker front; it is the CHANNEL front iff all
+      ``tokens_pushed_before`` earlier tokens have been popped
+      (``tok_pushed - q_len >= m_key // KEYMULT``); head-of-line
+      blocking applies to that front. The win: ring CONTENT is written
+      only when tokens are sent (per storm phase), not on every tick's
+      marker traffic — the dense per-tick [E, C] rewrite was >50% of
+      sync-tick time on TPU, and the former [E, C] sequence plane was
+      another whole ring array of traffic.
     """
 
     time: Any          # i32 []
@@ -160,13 +167,13 @@ class DenseState(NamedTuple):
     q_marker: Any      # bool [E, C]  ring mode only (False throughout in split)
     q_data: Any        # i32 [E, C]   token amount | snapshot id (ring mode)
     q_rtime: Any       # i32 [E, C]   delivery-eligible time
-    q_seq: Any         # i32 [E, C]   FIFO sequence number (split mode)
     q_head: Any        # i32 [E]
     q_len: Any         # i32 [E]
-    seq_next: Any      # i32 [E]      next FIFO sequence number (split mode)
+    tok_pushed: Any    # i32 [E]      tokens ever pushed (split-mode order)
+    mk_cnt: Any        # i32 [E]      markers ever pushed (split-mode order)
     m_pending: Any     # bool [S, E]  marker in flight (split mode)
     m_rtime: Any       # i32 [S, E]
-    m_seq: Any         # i32 [S, E]
+    m_key: Any         # i32 [S, E]   FIFO merge key (docstring above)
     next_sid: Any      # i32 []
     started: Any       # bool [S]
     has_local: Any     # bool [S, N]
@@ -195,13 +202,13 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         q_marker=np.zeros((e, c), b),
         q_data=np.zeros((e, c), i32),
         q_rtime=np.zeros((e, c), i32),
-        q_seq=np.zeros((e, c), i32),
         q_head=np.zeros(e, i32),
         q_len=np.zeros(e, i32),
-        seq_next=np.zeros(e, i32),
+        tok_pushed=np.zeros(e, i32),
+        mk_cnt=np.zeros(e, i32),
         m_pending=np.zeros((s, e), b),
         m_rtime=np.zeros((s, e), i32),
-        m_seq=np.zeros((s, e), i32),
+        m_key=np.zeros((s, e), i32),
         next_sid=np.int32(0),
         started=np.zeros(s, b),
         has_local=np.zeros((s, n), b),

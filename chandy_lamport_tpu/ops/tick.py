@@ -93,6 +93,21 @@ def count_dtype(topo: DenseTopology, override: str = "auto",
     return jnp.float32
 
 
+def merge_keymult(max_snapshots: int) -> int:
+    """Split-mode FIFO merge-key multiplier: m_key = tok_before * KEYMULT +
+    marker_ord (DenseState docstring). marker_ord < S (each slot pushes each
+    edge at most once, node.go:154-156), so the next power of two above S
+    keeps keys unique per edge and sorted by push order. ONE definition for
+    the dense and graph-sharded kernels."""
+    return 1 << max(4, max_snapshots.bit_length())
+
+
+def merge_key_limit(max_snapshots: int) -> int:
+    """Largest tok_pushed for which a merge key fits int32; token-push sites
+    flag ERR_VALUE_OVERFLOW at this bound so a key can never wrap."""
+    return (1 << 31) // merge_keymult(max_snapshots) - 1
+
+
 def log_append(log_amt, rec_cnt, min_prot, recording, tok_e, amt_e,
                rec_dtype, rec_limit, log_slots: int):
     """Shared-log append for one sync tick, vector form (DenseState
@@ -159,6 +174,8 @@ class TickKernel:
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
+        self._keymult = merge_keymult(cfg.max_snapshots)
+        self._key_limit = merge_key_limit(cfg.max_snapshots)
         # static topology constants baked into the traces
         self._edge_src = jnp.asarray(topo.edge_src, _i32)
         self._edge_dst = jnp.asarray(topo.edge_dst, _i32)
@@ -277,13 +294,17 @@ class TickKernel:
         C = self.cfg.queue_capacity
         pos = (s.q_head[e] + s.q_len[e]) % C
         err = s.error | jnp.where(s.q_len[e] >= C, ERR_QUEUE_OVERFLOW, 0).astype(_i32)
+        err = err | jnp.where(s.tok_pushed[e] >= self._key_limit,
+                              ERR_VALUE_OVERFLOW, 0).astype(_i32)
         return s._replace(
             q_marker=s.q_marker.at[e, pos].set(is_marker),
             q_data=s.q_data.at[e, pos].set(jnp.asarray(data, _i32)),
             q_rtime=s.q_rtime.at[e, pos].set(jnp.asarray(rtime, _i32)),
-            q_seq=s.q_seq.at[e, pos].set(s.seq_next[e]),
             q_len=s.q_len.at[e].add(1),
-            seq_next=s.seq_next.at[e].add(1),
+            # split-mode merge-order counter; meaningless (but harmless) in
+            # ring mode, where _push also carries markers and FIFO order is
+            # the ring itself
+            tok_pushed=s.tok_pushed.at[e].add(1),
             delay_state=dstate,
             error=err,
         )
@@ -298,8 +319,9 @@ class TickKernel:
         return s._replace(
             m_pending=s.m_pending.at[sid, e].set(True),
             m_rtime=s.m_rtime.at[sid, e].set(jnp.asarray(rtime, _i32)),
-            m_seq=s.m_seq.at[sid, e].set(s.seq_next[e]),
-            seq_next=s.seq_next.at[e].add(1),
+            m_key=s.m_key.at[sid, e].set(
+                s.tok_pushed[e] * self._keymult + s.mk_cnt[e]),
+            mk_cnt=s.mk_cnt.at[e].add(1),
             delay_state=dstate,
         )
 
@@ -448,9 +470,10 @@ class TickKernel:
 
         Requires marker_mode="split" (DenseState docstring): tokens live in
         the ring, markers in the [S, E] pending planes, and the merged
-        channel's FIFO front is the live item with the smallest sequence
-        number — identical delivery schedule to the unified ring, but a
-        tick touches no [E, C] ring content (the dense per-tick rewrite was
+        channel's front is the min-merge-key pending marker when all
+        tokens pushed before it have been popped, else the ring head —
+        identical delivery schedule to the unified ring, but a tick
+        touches no [E, C] ring content (the dense per-tick rewrite was
         >50% of tick time on TPU).
         """
         if self.marker_mode != "split":
@@ -464,25 +487,26 @@ class TickKernel:
 
         # ---- channel fronts: token head via one-hot reads over the
         # capacity axis; marker front = the pending marker with the
-        # smallest sequence number. Whichever of the two has the smaller
-        # sequence number is the channel's FIFO front, and head-of-line
-        # blocking (queue.go semantics) applies to that front's
-        # receive time.
+        # smallest merge key (DenseState docstring: key = tokens-pushed-
+        # before x KEYMULT + marker ord, unique per edge, sorted by push
+        # order). The marker front is the CHANNEL front iff every token
+        # pushed before it has been popped; head-of-line blocking
+        # (queue.go semantics) applies to that front's receive time.
         head_hit = cc == s.q_head[:, None]                        # [E, C]
         head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
         head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
-        head_seq = jnp.sum(jnp.where(head_hit, s.q_seq, 0), axis=-1, dtype=_i32)
         tok_live = s.q_len > 0
-        tok_seq = jnp.where(tok_live, head_seq, BIG)              # [E]
-        m_seq_live = jnp.where(s.m_pending, s.m_seq, BIG)         # [S, E]
-        m_front_seq = jnp.min(m_seq_live, axis=-2)                # [E]
+        tok_popped = s.tok_pushed - s.q_len                       # [E]
+        m_key_live = jnp.where(s.m_pending, s.m_key, BIG)         # [S, E]
+        m_front_key = jnp.min(m_key_live, axis=-2)                # [E]
         m_is_front = s.m_pending & (
-            m_seq_live == jnp.expand_dims(m_front_seq, -2))       # [S, E]
+            m_key_live == jnp.expand_dims(m_front_key, -2))       # [S, E]
         m_front_rt = jnp.sum(jnp.where(m_is_front, s.m_rtime, 0),
                              axis=-2, dtype=_i32)                 # [E]
-        front_is_marker = m_front_seq < tok_seq                   # [E]
+        front_is_marker = (m_front_key < BIG) & (
+            m_front_key // self._keymult <= tok_popped)           # [E]
         front_rt = jnp.where(front_is_marker, m_front_rt, head_rt)
-        elig_e = (tok_live | (m_front_seq < BIG)) & (front_rt <= time)
+        elig_e = (tok_live | front_is_marker) & (front_rt <= time)
         # at most one delivery per source: first eligible edge in dest
         # order, via an exclusive prefix count re-based at each source's
         # first edge (edges are per-source contiguous) — O(E)
@@ -610,13 +634,14 @@ class TickKernel:
         pos = (s.q_head + s.q_len) % C
         hit = active[:, None] & (jnp.arange(C, dtype=_i32)[None, :] == pos[:, None])
         data = jnp.broadcast_to(jnp.asarray(data, _i32), active.shape)
+        err = err | jnp.where(jnp.any(active & (s.tok_pushed >= self._key_limit)),
+                              ERR_VALUE_OVERFLOW, 0).astype(_i32)
         return s._replace(
             q_marker=jnp.where(hit, is_marker, s.q_marker),
             q_data=jnp.where(hit, data[:, None], s.q_data),
             q_rtime=jnp.where(hit, jnp.asarray(rts, _i32)[:, None], s.q_rtime),
-            q_seq=jnp.where(hit, s.seq_next[:, None], s.q_seq),
             q_len=s.q_len + active.astype(_i32),
-            seq_next=s.seq_next + active.astype(_i32),
+            tok_pushed=s.tok_pushed + active.astype(_i32),
             delay_state=dstate,
             error=err,
         )
@@ -636,25 +661,26 @@ class TickKernel:
 
     def _push_markers_split(self, s: DenseState, push_se) -> DenseState:
         """Marker multi-push in split mode: set the per-(slot, edge) pending
-        planes — no [E, C] ring content is touched. Sequence numbers are
-        allocated in slot order for markers pushed on the same edge this
-        tick (matching the ring representation's stacking order), so the
-        merged-FIFO delivery schedule is identical. One vectorized delay
-        draw per (slot, edge) with inactive draws discarded (fast-path
-        semantics). Cannot overflow: each (snapshot, edge) pair pushes at
-        most once ever (first-receipt broadcast only, node.go:154-156) and
-        has its own dedicated plane entry."""
+        planes — no [E, C] ring content is touched. Merge keys (DenseState
+        docstring) are allocated in slot order for markers pushed on the
+        same edge this tick (matching the ring representation's stacking
+        order), so the merged-FIFO delivery schedule is identical. One
+        vectorized delay draw per (slot, edge) with inactive draws
+        discarded (fast-path semantics). Cannot overflow the planes: each
+        (snapshot, edge) pair pushes at most once ever (first-receipt
+        broadcast only, node.go:154-156)."""
         S = self.cfg.max_snapshots
         rts_se, dstate = self.delay.draw_many(s.delay_state, s.time,
                                               (S, self.topo.e))
         off_se = jnp.cumsum(push_se, axis=-2, dtype=_i32) - push_se  # [S, E]
         k_e = jnp.sum(push_se, axis=-2, dtype=_i32)                  # [E]
-        seq_se = jnp.expand_dims(s.seq_next, -2) + off_se
+        key_se = (jnp.expand_dims(s.tok_pushed * self._keymult
+                                  + s.mk_cnt, -2) + off_se)
         return s._replace(
             m_pending=s.m_pending | push_se,
             m_rtime=jnp.where(push_se, jnp.asarray(rts_se, _i32), s.m_rtime),
-            m_seq=jnp.where(push_se, seq_se, s.m_seq),
-            seq_next=s.seq_next + k_e,
+            m_key=jnp.where(push_se, key_se, s.m_key),
+            mk_cnt=s.mk_cnt + k_e,
             delay_state=dstate,
         )
 

@@ -52,7 +52,12 @@ from chandy_lamport_tpu.core.state import (
     DenseTopology,
 )
 from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
-from chandy_lamport_tpu.ops.tick import log_append, window_update
+from chandy_lamport_tpu.ops.tick import (
+    log_append,
+    merge_key_limit,
+    merge_keymult,
+    window_update,
+)
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 
 _i32 = jnp.int32
@@ -100,21 +105,21 @@ class ShardedState(NamedTuple):
     """One giant instance, sharded on the leading axis of every leaf except
     the replicated scalars. Channel state uses the split representation
     (core/state.DenseState docstring): rings carry tokens only; markers
-    live in the [S, Em] pending planes with FIFO order preserved by
-    per-edge sequence numbers. Everything marker/queue is local to the
-    edge's (= its source node's) shard, so the split adds no collectives."""
+    live in the [S, Em] pending planes with FIFO order preserved by the
+    per-edge merge keys. Everything marker/queue is local to the edge's
+    (= its source node's) shard, so the split adds no collectives."""
 
     time: Any        # i32 [] (replicated)
     tokens: Any      # i32 [P, Nl]
     q_data: Any      # i32 [P, Em, C]
     q_rtime: Any     # i32 [P, Em, C]
-    q_seq: Any       # i32 [P, Em, C]
     q_head: Any      # i32 [P, Em]
     q_len: Any       # i32 [P, Em]
-    seq_next: Any    # i32 [P, Em]
+    tok_pushed: Any  # i32 [P, Em]
+    mk_cnt: Any      # i32 [P, Em]
     m_pending: Any   # bool [P, S, Em]
     m_rtime: Any     # i32 [P, S, Em]
-    m_seq: Any       # i32 [P, S, Em]
+    m_key: Any       # i32 [P, S, Em]  (merge key, DenseState docstring)
     next_sid: Any    # i32 [] (replicated)
     started: Any     # bool [S] (replicated)
     has_local: Any   # bool [P, S, Nl]
@@ -210,6 +215,8 @@ class GraphShardedRunner:
         self._cnt = count_dtype(self.topo, self.config.count_dtype)
         self._rec_dtype = jnp.dtype(self.config.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
+        self._keymult = merge_keymult(self.config.max_snapshots)
+        self._key_limit = merge_key_limit(self.config.max_snapshots)
         self.stopo, self.em = shard_topology(self.topo, self.shards,
                                              cnt_dtype=self._cnt)
         self.nl = self.topo.n // self.shards
@@ -231,9 +238,10 @@ class GraphShardedRunner:
             a_in_c=spec_sharded, a_src_c=spec_sharded, src_first=spec_sharded,
             in_degree=spec_rep)
         state_specs = ShardedState(
-            time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_rtime=spec_sharded, q_seq=spec_sharded,
-            q_head=spec_sharded, q_len=spec_sharded, seq_next=spec_sharded,
-            m_pending=spec_sharded, m_rtime=spec_sharded, m_seq=spec_sharded,
+            time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_rtime=spec_sharded,
+            q_head=spec_sharded, q_len=spec_sharded,
+            tok_pushed=spec_sharded, mk_cnt=spec_sharded,
+            m_pending=spec_sharded, m_rtime=spec_sharded, m_key=spec_sharded,
             next_sid=spec_rep, started=spec_rep,
             has_local=spec_sharded, frozen=spec_sharded, rem=spec_sharded,
             done_local=spec_sharded, recording=spec_sharded,
@@ -275,13 +283,13 @@ class GraphShardedRunner:
             tokens=tokens,
             q_data=np.zeros((p, em, c), np.int32),
             q_rtime=np.zeros((p, em, c), np.int32),
-            q_seq=np.zeros((p, em, c), np.int32),
             q_head=np.zeros((p, em), np.int32),
             q_len=np.zeros((p, em), np.int32),
-            seq_next=np.zeros((p, em), np.int32),
+            tok_pushed=np.zeros((p, em), np.int32),
+            mk_cnt=np.zeros((p, em), np.int32),
             m_pending=np.zeros((p, s, em), np.bool_),
             m_rtime=np.zeros((p, s, em), np.int32),
-            m_seq=np.zeros((p, s, em), np.int32),
+            m_key=np.zeros((p, s, em), np.int32),
             next_sid=np.int32(0),
             started=np.zeros(s, np.bool_),
             has_local=np.zeros((p, s, nl), np.bool_),
@@ -373,18 +381,20 @@ class GraphShardedRunner:
     def _push_markers_split(self, s: ShardedState, st: ShardedTopology,
                             push_se) -> ShardedState:
         """Local twin of TickKernel._push_markers_split: set the pending
-        planes, allocating sequence numbers in slot order per edge — no
-        [Em, C] ring content is touched and no collective is needed (every
-        marker lives on its edge's shard). Cannot overflow: each
-        (snapshot, edge) pushes at most once (node.go:154-156)."""
+        planes, allocating merge keys (DenseState docstring) in slot order
+        per edge — no [Em, C] ring content is touched and no collective is
+        needed (every marker lives on its edge's shard). Cannot overflow
+        the planes: each (snapshot, edge) pushes at most once
+        (node.go:154-156)."""
         rts_se, key = self._draw_many(s.delay_key, s.time, push_se.shape)
         off_se = jnp.cumsum(push_se, axis=0, dtype=_i32) - push_se
         k_e = jnp.sum(push_se, axis=0, dtype=_i32)
+        key_se = (s.tok_pushed * self._keymult + s.mk_cnt)[None, :] + off_se
         return s._replace(
             m_pending=s.m_pending | push_se,
             m_rtime=jnp.where(push_se, jnp.asarray(rts_se, _i32), s.m_rtime),
-            m_seq=jnp.where(push_se, s.seq_next[None, :] + off_se, s.m_seq),
-            seq_next=s.seq_next + k_e,
+            m_key=jnp.where(push_se, key_se, s.m_key),
+            mk_cnt=s.mk_cnt + k_e,
             delay_key=key,
         )
 
@@ -436,13 +446,15 @@ class GraphShardedRunner:
         cc = jnp.arange(C, dtype=_i32)[None, :]
         pos = (s.q_head + s.q_len) % C
         hit = active[:, None] & (cc == pos[:, None])
+        key_ovf = jnp.any(active & (s.tok_pushed >= self._key_limit)
+                          ).astype(_i32) * ERR_VALUE_OVERFLOW
         return s._replace(
             q_data=jnp.where(hit, amounts[:, None], s.q_data),
             q_rtime=jnp.where(hit, rts[:, None], s.q_rtime),
-            q_seq=jnp.where(hit, s.seq_next[:, None], s.q_seq),
             q_len=s.q_len + active.astype(_i32),
-            seq_next=s.seq_next + active.astype(_i32),
+            tok_pushed=s.tok_pushed + active.astype(_i32),
             delay_key=key,
+            error=s.error | self._por(key_ovf),
         )
 
     def _bulk_snapshots(self, s: ShardedState, st: ShardedTopology,
@@ -490,12 +502,13 @@ class GraphShardedRunner:
             q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i)),
             q_rtime=s.q_rtime.at[e, pos].set(
                 sel(s.q_rtime[e, pos], jnp.asarray(rt, _i32))),
-            q_seq=s.q_seq.at[e, pos].set(
-                sel(s.q_seq[e, pos], s.seq_next[e])),
             q_len=s.q_len.at[e].add(a),
-            seq_next=s.seq_next.at[e].add(a),
+            tok_pushed=s.tok_pushed.at[e].add(a),
             delay_key=key,
-            error=s.error | self._por(err_local),
+            error=s.error | self._por(
+                err_local
+                | (a & (s.tok_pushed[e] >= self._key_limit)).astype(_i32)
+                * ERR_VALUE_OVERFLOW),
         )
 
     def _sync_tick(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
@@ -516,18 +529,17 @@ class GraphShardedRunner:
         head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
         head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
                            dtype=_i32)
-        head_seq = jnp.sum(jnp.where(head_hit, s.q_seq, 0), axis=-1,
-                           dtype=_i32)
         tok_live = s.q_len > 0
-        tok_seq = jnp.where(tok_live, head_seq, BIG)
-        m_seq_live = jnp.where(s.m_pending, s.m_seq, BIG)        # [S, Em]
-        m_front_seq = jnp.min(m_seq_live, axis=0)                # [Em]
-        m_is_front = s.m_pending & (m_seq_live == m_front_seq[None, :])
+        tok_popped = s.tok_pushed - s.q_len
+        m_key_live = jnp.where(s.m_pending, s.m_key, BIG)        # [S, Em]
+        m_front_key = jnp.min(m_key_live, axis=0)                # [Em]
+        m_is_front = s.m_pending & (m_key_live == m_front_key[None, :])
         m_front_rt = jnp.sum(jnp.where(m_is_front, s.m_rtime, 0),
                              axis=0, dtype=_i32)
-        front_is_marker = m_front_seq < tok_seq
+        front_is_marker = (m_front_key < BIG) & (
+            m_front_key // self._keymult <= tok_popped)
         front_rt = jnp.where(front_is_marker, m_front_rt, head_rt)
-        elig = (tok_live | (m_front_seq < BIG)) & (front_rt <= time)
+        elig = (tok_live | front_is_marker) & (front_rt <= time)
         elig_i = elig.astype(_i32)
         before = jnp.cumsum(elig_i) - elig_i
         deliver = elig & (before == before[st.src_first])
@@ -804,13 +816,13 @@ class GraphShardedRunner:
                               np.bool_),
             q_data=edges(h.q_data),
             q_rtime=edges(h.q_rtime),
-            q_seq=edges(h.q_seq),
             q_head=edges(h.q_head),
             q_len=edges(h.q_len),
-            seq_next=edges(h.seq_next),
+            tok_pushed=edges(h.tok_pushed),
+            mk_cnt=edges(h.mk_cnt),
             m_pending=slot_edges(h.m_pending),
             m_rtime=slot_edges(h.m_rtime),
-            m_seq=slot_edges(h.m_seq),
+            m_key=slot_edges(h.m_key),
             next_sid=np.asarray(h.next_sid),
             started=np.asarray(h.started),
             has_local=nodes(h.has_local),

@@ -87,7 +87,7 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 13·E·C + (20 + rec·L)·E + 4·N + S·(1 + 10·N + 18·E)
+    footprint = 9·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + 18·E)
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
     and L = cfg.max_recorded (shared per-edge log slots).
 
@@ -100,13 +100,13 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     n, e = num_nodes, num_edges
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
     rec = np.dtype(cfg.record_dtype).itemsize
-    # q_* rings (marker/data/rtime/seq) + head/len/seq_next
-    queues = e * c * (1 + 4 + 4 + 4) + e * (4 + 4 + 4)
+    # q_* rings (marker/data/rtime) + head/len/tok_pushed/mk_cnt
+    queues = e * c * (1 + 4 + 4) + e * (4 + 4 + 4 + 4)
     nodes = 4 * n                                       # tokens
     # per-edge recording log: rec_cnt/min_prot + log_amt[L, E]
     rec_log = e * (4 + 4) + rec * m * e
     # per slot: started + [S,N] planes + recording + window counters
-    # (start/end) + split-marker planes m_pending/m_rtime/m_seq
+    # (start/end) + split-marker planes m_pending/m_rtime/m_key
     snaps = s * (1 + n * (1 + 4 + 4 + 1)
                  + e * (1 + 4 * 2) + e * (1 + 4 + 4))
     scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed

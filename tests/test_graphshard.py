@@ -84,13 +84,14 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
     # split representation: rings never hold markers (the sharded state has
     # no marker plane at all; the dense one must be all-False)
     assert not np.asarray(ref_final.q_marker).any()
-    for name in ("q_data", "q_rtime", "q_seq", "q_head", "q_len", "seq_next"):
+    for name in ("q_data", "q_rtime", "q_head", "q_len",
+                 "tok_pushed", "mk_cnt"):
         parts = [getattr(final, name)[p][:counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=0)
         want = getattr(ref_final, name)[perm]
         np.testing.assert_array_equal(got, want, err_msg=name)
     for name in ("recording", "rec_start", "rec_end",
-                 "m_pending", "m_rtime", "m_seq"):
+                 "m_pending", "m_rtime", "m_key"):
         parts = [getattr(final, name)[p][:, :counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=1)
         want = getattr(ref_final, name)[:, perm]

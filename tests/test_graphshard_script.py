@@ -59,8 +59,8 @@ def test_script_sharded_matches_unsharded(top, events, shards):
 
     assert int(got.error) == 0
     for name in ("time", "tokens", "q_marker", "q_data", "q_rtime", "q_head",
-                 "q_len", "q_seq", "seq_next", "m_pending", "m_rtime",
-                 "m_seq", "next_sid", "started", "has_local", "frozen", "rem",
+                 "q_len", "tok_pushed", "mk_cnt", "m_pending", "m_rtime",
+                 "m_key", "next_sid", "started", "has_local", "frozen", "rem",
                  "done_local", "recording", "rec_cnt", "min_prot",
                  "log_amt", "rec_start", "rec_end", "completed"):
         np.testing.assert_array_equal(
