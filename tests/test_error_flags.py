@@ -96,6 +96,24 @@ def test_tick_limit_fires_on_non_strongly_connected_graph():
     assert _err(sim) & ERR_TICK_LIMIT
 
 
+def test_merge_key_overflow_fires():
+    """Token pushes past merge_key_limit must flag ERR_VALUE_OVERFLOW
+    before a marker merge key (tok_pushed * KEYMULT + ord) could wrap
+    int32 and silently reorder the FIFO (ops/tick.py merge-key scheme)."""
+    from chandy_lamport_tpu.ops.tick import merge_key_limit
+
+    runner = BatchedRunner(_pair(), SimConfig(), FixedJaxDelay(1), batch=1,
+                           scheduler="sync")
+    state = runner.init_batch()
+    limit = merge_key_limit(runner.config.max_snapshots)
+    state = state._replace(
+        tok_pushed=np.full_like(np.asarray(state.tok_pushed), limit))
+    script = compile_events(runner.topo, [
+        PassTokenEvent("N1", "N2", 1), TickEvent(1)])
+    final = jax.device_get(runner.run(jax.device_put(state), script))
+    assert int(np.asarray(final.error)[0]) & ERR_VALUE_OVERFLOW
+
+
 def test_decode_errors_names_every_bit():
     bits = (ERR_QUEUE_OVERFLOW | ERR_SNAPSHOT_OVERFLOW | ERR_RECORD_OVERFLOW
             | ERR_TOKEN_UNDERFLOW | ERR_TICK_LIMIT | ERR_VALUE_OVERFLOW)
