@@ -33,7 +33,7 @@ Bit-exactness-critical rules replicated (citations into the reference):
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Tuple
 
 from chandy_lamport_tpu.config import MAX_DELAY
 from chandy_lamport_tpu.core.spec import (

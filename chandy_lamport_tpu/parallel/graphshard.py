@@ -51,7 +51,6 @@ from chandy_lamport_tpu.core.state import (
     F32_EXACT_LIMIT,
     DenseTopology,
 )
-from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
 from chandy_lamport_tpu.ops.tick import (
     log_append,
     merge_key_limit,

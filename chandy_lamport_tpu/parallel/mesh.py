@@ -22,7 +22,7 @@ Everything here works identically on a real TPU slice and on the CPU
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import numpy as np

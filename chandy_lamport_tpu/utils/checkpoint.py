@@ -17,7 +17,7 @@ inspectable.
 from __future__ import annotations
 
 import json
-from typing import Any, Tuple
+from typing import Tuple
 
 import jax
 import numpy as np

@@ -14,7 +14,7 @@ its equivalents are (a) aggregate counters reduced from DenseState
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import List
 
 from chandy_lamport_tpu.core.spec import Message
 
