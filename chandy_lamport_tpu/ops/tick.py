@@ -296,8 +296,7 @@ class TickKernel:
         err = s.error | jnp.where(s.q_len[e] >= C, ERR_QUEUE_OVERFLOW, 0).astype(_i32)
         err = err | jnp.where(s.tok_pushed[e] >= self._key_limit,
                               ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        return s._replace(
-            q_marker=s.q_marker.at[e, pos].set(is_marker),
+        s = s._replace(
             q_data=s.q_data.at[e, pos].set(jnp.asarray(data, _i32)),
             q_rtime=s.q_rtime.at[e, pos].set(jnp.asarray(rtime, _i32)),
             q_len=s.q_len.at[e].add(1),
@@ -308,6 +307,9 @@ class TickKernel:
             delay_state=dstate,
             error=err,
         )
+        if self.marker_mode == "split" and not is_marker:
+            return s  # split-mode rings never hold markers (all-False plane)
+        return s._replace(q_marker=s.q_marker.at[e, pos].set(is_marker))
 
     def _push_marker(self, s: DenseState, e, sid) -> DenseState:
         """Scalar marker enqueue, routed by marker_mode: into the ring
@@ -636,8 +638,7 @@ class TickKernel:
         data = jnp.broadcast_to(jnp.asarray(data, _i32), active.shape)
         err = err | jnp.where(jnp.any(active & (s.tok_pushed >= self._key_limit)),
                               ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        return s._replace(
-            q_marker=jnp.where(hit, is_marker, s.q_marker),
+        s = s._replace(
             q_data=jnp.where(hit, data[:, None], s.q_data),
             q_rtime=jnp.where(hit, jnp.asarray(rts, _i32)[:, None], s.q_rtime),
             q_len=s.q_len + active.astype(_i32),
@@ -645,6 +646,11 @@ class TickKernel:
             delay_state=dstate,
             error=err,
         )
+        if self.marker_mode == "split" and not is_marker:
+            # split-mode rings never hold markers: q_marker stays all-False,
+            # so skip its [E, C] read+write entirely
+            return s
+        return s._replace(q_marker=jnp.where(hit, is_marker, s.q_marker))
 
     def _bulk_send(self, s: DenseState, amounts) -> DenseState:
         """Vectorized token injection: one message per edge with amounts[e]>0
