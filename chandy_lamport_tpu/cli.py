@@ -105,7 +105,8 @@ def _cmd_storm(args) -> int:
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
-                           batch=args.batch, scheduler=args.scheduler)
+                           batch=args.batch, scheduler=args.scheduler,
+                           check_every=args.check_every)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
         snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
@@ -175,6 +176,10 @@ def main(argv=None) -> int:
                     default="int32")
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
                     default="auto")
+    ps.add_argument("--check-every", type=int, default=0,
+                    help="evaluate the token-conservation invariant inside "
+                         "the run every K phases (0 = off); violations set "
+                         "the sticky ERR_CONSERVATION bit")
     ps.add_argument("--delay", choices=["uniform", "hash"],
                     default="hash",
                     help="fast-path delay sampler (same default as bench "

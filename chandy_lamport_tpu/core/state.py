@@ -49,6 +49,7 @@ ERR_RECORD_OVERFLOW = 4
 ERR_TOKEN_UNDERFLOW = 8
 ERR_TICK_LIMIT = 16
 ERR_VALUE_OVERFLOW = 32
+ERR_CONSERVATION = 64
 
 # largest token amount the sync scheduler's f32 incidence matmuls carry
 # exactly; amounts at or beyond this fire ERR_VALUE_OVERFLOW instead of
@@ -66,6 +67,11 @@ ERROR_NAMES = {
                         ">= 2^24 on the sync scheduler's f32 reductions "
                         "(use scheduler='exact'), or beyond the configured "
                         "record_dtype range (use record_dtype='int32')",
+    ERR_CONSERVATION: "in-run token-conservation check failed "
+                      "(node balances + in-flight != initial total; "
+                      "BatchedRunner check_every — the reference's "
+                      "checkTokens invariant, test_common.go:298-328, "
+                      "evaluated inside the jit run)",
 }
 
 

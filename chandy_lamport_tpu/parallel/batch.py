@@ -106,11 +106,19 @@ class BatchedRunner:
     """
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
-                 delay: JaxDelay, batch: int, scheduler: str = "exact"):
+                 delay: JaxDelay, batch: int, scheduler: str = "exact",
+                 check_every: int = 0):
         """scheduler: 'exact' = the reference's sequential source fold
         (bit-exact, O(N) sequential steps per tick); 'sync' = simultaneous
         delivery (deterministic, protocol-equivalent, O(E) vectorized work
-        per tick — the production/benchmark path, ops/tick._sync_tick)."""
+        per tick — the production/benchmark path, ops/tick._sync_tick).
+
+        check_every: if > 0, evaluate the token-conservation invariant
+        (the reference's checkTokens, test_common.go:298-328) INSIDE the
+        jitted storm run every K phases and once after drain, setting the
+        sticky ERR_CONSERVATION bit on any lane where node balances +
+        in-flight ring tokens drift from the initial total (SURVEY.md §5:
+        the jit-compatible sanitizer evaluated every K ticks)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -136,6 +144,9 @@ class BatchedRunner:
             self._tick_fn = self.kernel._sync_tick
             self._drain_fn = self.kernel._sync_drain_and_flush
         self.scheduler = scheduler
+        if check_every < 0:
+            raise ValueError("check_every must be >= 0 (0 = off)")
+        self.check_every = int(check_every)
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
         self._run_no_drain = jax.jit(
@@ -254,18 +265,34 @@ class BatchedRunner:
             s = lax.fori_loop(0, snaps.shape[-1], body, s)
         return self._tick_fn(s)
 
+    def _check_conservation(self, s: DenseState) -> DenseState:
+        from chandy_lamport_tpu.core.state import ERR_CONSERVATION
+        from chandy_lamport_tpu.utils.metrics import conservation_delta
+
+        delta = conservation_delta(s, self.config,
+                                   int(self.topo.tokens0.sum()))
+        return s._replace(error=s.error | jnp.where(
+            delta != 0, ERR_CONSERVATION, 0).astype(jnp.int32))
+
     def _run_storm_phases(self, s: DenseState, program) -> DenseState:
         amounts, snap = program
+        k = self.check_every
 
         def phase(s, xs):
-            return self.storm_phase(s, xs[0], xs[1]), None
+            s = self.storm_phase(s, xs[0], xs[1])
+            if k:
+                s = lax.cond((xs[2] + 1) % k == 0,
+                             self._check_conservation, lambda s: s, s)
+            return s, None
 
-        s, _ = lax.scan(phase, s, (amounts, snap))
+        idx = jnp.arange(amounts.shape[0], dtype=jnp.int32)
+        s, _ = lax.scan(phase, s, (amounts, snap, idx))
         return s
 
     def _run_storm_single(self, s: DenseState, program) -> DenseState:
         s = self._run_storm_phases(s, program)
-        return self._drain_fn(s)
+        s = self._drain_fn(s)
+        return self._check_conservation(s) if self.check_every else s
 
     def run_storm(self, state: DenseState, program,
                   drain: bool = True) -> DenseState:

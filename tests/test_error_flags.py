@@ -115,9 +115,43 @@ def test_merge_key_overflow_fires():
 
 
 def test_decode_errors_names_every_bit():
+    from chandy_lamport_tpu.core.state import ERR_CONSERVATION
+
     bits = (ERR_QUEUE_OVERFLOW | ERR_SNAPSHOT_OVERFLOW | ERR_RECORD_OVERFLOW
-            | ERR_TOKEN_UNDERFLOW | ERR_TICK_LIMIT | ERR_VALUE_OVERFLOW)
-    assert len(decode_errors(bits)) == 6
+            | ERR_TOKEN_UNDERFLOW | ERR_TICK_LIMIT | ERR_VALUE_OVERFLOW
+            | ERR_CONSERVATION)
+    assert len(decode_errors(bits)) == 7
+
+
+def test_conservation_check_fires_on_corrupted_state():
+    """BatchedRunner(check_every=K) evaluates the checkTokens invariant
+    (test_common.go:298-328) inside the jitted run: a clean storm stays
+    clean, a corrupted balance flags ERR_CONSERVATION on that lane only."""
+    from chandy_lamport_tpu.core.state import ERR_CONSERVATION
+    from chandy_lamport_tpu.models.workloads import (
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+
+    spec = scale_free(16, 2, seed=5, tokens=30)
+    runner = BatchedRunner(spec, SimConfig(), FixedJaxDelay(2), batch=2,
+                           scheduler="sync", check_every=2)
+    prog = storm_program(
+        runner.topo, phases=6, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 2, 1, 2,
+                                            max_phases=6))
+    clean = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+    assert int(np.asarray(clean.error).sum()) == 0
+
+    bad = runner.init_batch()
+    tokens = np.asarray(bad.tokens).copy()
+    tokens[1, 0] += 7  # lane 1 conjures tokens from nowhere
+    bad = bad._replace(tokens=tokens)
+    final = jax.device_get(runner.run_storm(bad, prog))
+    errs = np.asarray(final.error)
+    assert not errs[0] & ERR_CONSERVATION
+    assert errs[1] & ERR_CONSERVATION
 
 
 # ---------------------------------------------------------------------------
