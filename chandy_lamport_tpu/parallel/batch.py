@@ -33,7 +33,12 @@ from chandy_lamport_tpu.core.spec import (
     SnapshotEvent,
     TickEvent,
 )
-from chandy_lamport_tpu.core.state import DenseState, DenseTopology, init_state
+from chandy_lamport_tpu.core.state import (
+    DenseState,
+    DenseTopology,
+    ERR_CONSERVATION,
+    init_state,
+)
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 from chandy_lamport_tpu.ops.tick import TickKernel
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
@@ -266,7 +271,6 @@ class BatchedRunner:
         return self._tick_fn(s)
 
     def _check_conservation(self, s: DenseState) -> DenseState:
-        from chandy_lamport_tpu.core.state import ERR_CONSERVATION
         from chandy_lamport_tpu.utils.metrics import conservation_delta
 
         delta = conservation_delta(s, self.config,
@@ -287,7 +291,9 @@ class BatchedRunner:
 
         idx = jnp.arange(amounts.shape[0], dtype=jnp.int32)
         s, _ = lax.scan(phase, s, (amounts, snap, idx))
-        return s
+        # a no-drain run must not end between check points with a clean bit
+        # misread as "verified through end of run"
+        return self._check_conservation(s) if k else s
 
     def _run_storm_single(self, s: DenseState, program) -> DenseState:
         s = self._run_storm_phases(s, program)

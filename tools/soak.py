@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Randomized oracle-differential soak for the sync scheduler.
+
+CI's differential suites (tests/test_sync_differential.py,
+tests/test_bf16_and_capacity.py) run a handful of fixed seeds; this tool
+drives an arbitrary number of random (graph, program, delay) combinations
+through the dense sync kernel and the independent SyncOracle and demands
+exact agreement on balances, time, and every snapshot's per-edge recorded
+window — the deep-confidence battery for representation changes (window
+log, merge keys, split markers). Each case also runs the in-run
+conservation sanitizer (check_every).
+
+Usage: python tools/soak.py [--cases N] [--seed-base S]
+Prints one JSON line; exit 1 on any mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--cases", type=int, default=24)
+    p.add_argument("--seed-base", type=int, default=9000)
+    args = p.parse_args()
+
+    import jax
+
+    # the env var alone cannot override this image's TPU plugin; a soak is
+    # CPU work and must not hang when the device tunnel is down
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.core.state import DenseTopology, recorded_window
+    from chandy_lamport_tpu.core.syncsim import SyncOracle
+    from chandy_lamport_tpu.models.delay import FixedDelay
+    from chandy_lamport_tpu.models.workloads import erdos_renyi, scale_free
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+
+    t0 = time.perf_counter()
+    fails = []
+    for case in range(args.cases):
+        rng = random.Random(args.seed_base + case)
+        n = rng.randrange(4, 20)
+        spec = (scale_free(n, 2, seed=case, tokens=80) if case % 2
+                else erdos_renyi(max(n, 5), 2.5, seed=case, tokens=80))
+        topo = DenseTopology(spec)
+        delay = rng.randrange(1, 5)
+        phases = rng.randrange(5, 14)
+        amounts = np.zeros((phases, topo.e), np.int32)
+        floor = topo.tokens0.astype(np.int64).copy()
+        for ph in range(phases):
+            for e in rng.sample(range(topo.e), k=max(1, topo.e // 2)):
+                src = int(topo.edge_src[e])
+                if floor[src] >= 2:
+                    amounts[ph, e] += 1
+                    floor[src] -= 1
+        n_snaps = rng.randrange(1, 4)
+        snap = np.full((phases, n_snaps), -1, np.int32)
+        for j in range(n_snaps):
+            snap[rng.randrange(phases), j] = rng.randrange(topo.n)
+
+        runner = BatchedRunner(
+            spec, SimConfig(queue_capacity=32, max_recorded=128,
+                            max_snapshots=8),
+            FixedJaxDelay(delay), batch=1, scheduler="sync", check_every=3)
+        final = jax.device_get(
+            runner.run_storm(runner.init_batch(), (amounts, snap)))
+        lane = jax.tree_util.tree_map(lambda x: x[0], final)
+
+        oracle = SyncOracle(topo, FixedDelay(delay))
+        for ph in range(phases):
+            oracle.bulk_send([int(a) for a in amounts[ph]])
+            nodes = [int(x) for x in snap[ph] if x >= 0]
+            if nodes:
+                oracle.start_snapshots(nodes)
+            oracle.tick()
+        oracle.drain_and_flush()
+
+        ok = (int(lane.error) == 0
+              and oracle.tokens == [int(t) for t in lane.tokens]
+              and oracle.time == int(lane.time))
+        if ok:
+            for sid in range(int(lane.next_sid)):
+                for e in range(topo.e):
+                    if (oracle.recorded[sid].get(e, [])
+                            != recorded_window(lane, sid, e)):
+                        ok = False
+        print(f"case {case}: {'ok' if ok else 'MISMATCH'} "
+              f"(n={topo.n} e={topo.e} delay={delay} phases={phases})",
+              file=sys.stderr, flush=True)
+        if not ok:
+            fails.append(case)
+
+    print(json.dumps({
+        "metric": "soak_oracle_match",
+        "cases": args.cases,
+        "matched": args.cases - len(fails),
+        "failed_cases": fails,
+        "seconds": round(time.perf_counter() - t0, 1),
+    }))
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
