@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chandy_lamport_tpu.config import SimConfig
 from chandy_lamport_tpu.core.state import (
+    ERR_CONSERVATION,
     ERR_QUEUE_OVERFLOW,
     ERR_RECORD_OVERFLOW,
     ERR_SNAPSHOT_OVERFLOW,
@@ -193,16 +194,26 @@ class GraphShardedRunner:
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
-                 max_delay: int = 5, fixed_delay: Optional[int] = None):
+                 max_delay: int = 5, fixed_delay: Optional[int] = None,
+                 check_every: int = 0):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
-        unsharded kernel (counter-based streams differ by construction)."""
+        unsharded kernel (counter-based streams differ by construction).
+
+        check_every: if > 0, evaluate the token-conservation invariant
+        every K storm phases and after drain (one psum of the per-shard
+        balances + in-flight ring tokens vs the initial total), setting
+        the replicated sticky ERR_CONSERVATION bit — the sharded twin of
+        BatchedRunner's sanitizer."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.mesh = mesh
         self.axis = axis
         self.shards = mesh.shape[axis]
         self.seed = seed
+        if check_every < 0:
+            raise ValueError("check_every must be >= 0 (0 = off)")
+        self.check_every = int(check_every)
         self.max_delay = fixed_delay if fixed_delay is not None else max_delay
         self.fixed_delay = fixed_delay
         if self.config.max_delay != self.max_delay:
@@ -604,6 +615,19 @@ class GraphShardedRunner:
     def _pending(self, s: ShardedState):
         return jnp.any(s.started & (s.completed < self.topo.n))
 
+    def _check_conservation(self, s: ShardedState) -> ShardedState:
+        """The sharded twin of BatchedRunner._check_conservation: one psum
+        of per-shard (balances + in-flight ring tokens) vs the initial
+        total; pad edges have q_len 0 so they contribute nothing."""
+        from chandy_lamport_tpu.utils.metrics import _occupied
+
+        occ = _occupied(s, self.config)
+        local = jnp.sum(s.tokens) + jnp.sum(jnp.where(occ, s.q_data, 0))
+        total = lax.psum(local, self.axis)
+        return s._replace(error=s.error | jnp.where(
+            total != int(self.topo.tokens0.sum()),
+            ERR_CONSERVATION, 0).astype(_i32))
+
     def _unwrap(self, tree, specs):
         """Inside shard_map the sharded leading axis arrives as a singleton;
         strip it so the kernel sees per-shard logical shapes."""
@@ -618,6 +642,27 @@ class GraphShardedRunner:
             lambda x, sp: x[None] if sp == sharded else x, tree, specs,
             is_leaf=lambda x: x is None)
 
+    def _storm_scan(self, s: ShardedState, st: ShardedTopology,
+                    amounts, snap) -> ShardedState:
+        """Scan the storm phases with the conservation-check cadence, then
+        drain + final check — ONE definition for the single-mesh and
+        data-batched bodies so their invariant coverage cannot drift."""
+        k = self.check_every
+
+        def phase(s, xs):
+            s = self._storm_phase(s, st, xs[0], xs[1])
+            if k:
+                # the predicate is replicated, so the cond (whose true
+                # branch psums) stays uniform across shards
+                s = lax.cond((xs[2] + 1) % k == 0,
+                             self._check_conservation, lambda s: s, s)
+            return s, None
+
+        idx = jnp.arange(amounts.shape[0], dtype=_i32)
+        s, _ = lax.scan(phase, s, (amounts, snap, idx))
+        s = self._drain_flush(s, st)
+        return self._check_conservation(s) if k else s
+
     def _run_storm_body(self, s: ShardedState, st: ShardedTopology,
                         program) -> ShardedState:
         wrap_specs = self._state_specs
@@ -625,13 +670,7 @@ class GraphShardedRunner:
         st = self._unwrap(st, self._topo_specs)
         amounts, snap = program  # [T, 1, Em] shard slice, [T, J] replicated
         amounts = amounts[:, 0, :]
-        program = (amounts, snap)
-
-        def phase(s, xs):
-            return self._storm_phase(s, st, xs[0], xs[1]), None
-
-        s, _ = lax.scan(phase, s, (amounts, snap))
-        return self._wrap(self._drain_flush(s, st), wrap_specs)
+        return self._wrap(self._storm_scan(s, st, amounts, snap), wrap_specs)
 
     def _storm_phase(self, s: ShardedState, st: ShardedTopology,
                      amts, snaps) -> ShardedState:
@@ -688,7 +727,10 @@ class GraphShardedRunner:
                             lambda s: s, s), None
 
         s, _ = lax.scan(phase, s, tuple(script))
-        return self._wrap(self._drain_flush(s, st), wrap_specs)
+        s = self._drain_flush(s, st)
+        if self.check_every:
+            s = self._check_conservation(s)
+        return self._wrap(s, wrap_specs)
 
     def run_script(self, state: ShardedState, events) -> ShardedState:
         """Execute an event script (reference .events semantics under the
@@ -772,11 +814,7 @@ class GraphShardedRunner:
             s, self._state_specs)
 
         def one_lane(s):
-            def phase(s, xs):
-                return self._storm_phase(s, st, xs[0], xs[1]), None
-
-            s, _ = lax.scan(phase, s, (amounts, snap))
-            return self._drain_flush(s, st)
+            return self._storm_scan(s, st, amounts, snap)
 
         s = jax.vmap(one_lane)(s)
         return jax.tree_util.tree_map(

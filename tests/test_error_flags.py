@@ -311,3 +311,37 @@ def test_graphshard_value_overflow_fires():
     snap = np.full((2, 1), -1, np.int32)
     final = gs.run_storm(gs.init_state(), amounts, snap)
     assert _gs_err(gs, final) & ERR_VALUE_OVERFLOW
+
+
+def test_graphshard_conservation_check_fires():
+    """GraphShardedRunner(check_every=K): a clean sharded storm stays clean;
+    corrupting one shard's balances flags the replicated ERR_CONSERVATION
+    bit via the in-run psum check."""
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.core.state import ERR_CONSERVATION
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+
+    spec = erdos_renyi(16, 2.5, seed=11, tokens=80)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("graph",))
+    gs = GraphShardedRunner(spec, SimConfig(queue_capacity=16),
+                            mesh, fixed_delay=2, check_every=2)
+    prog = storm_program(gs.topo, phases=6, amount=1,
+                         snapshot_phases=staggered_snapshots(gs.topo, 2))
+    clean = jax.device_get(gs.run_storm(gs.init_state(),
+                                        np.asarray(prog.amounts),
+                                        np.asarray(prog.snap)))
+    assert int(clean.error) == 0
+
+    bad = jax.device_get(gs.init_state())
+    tokens = np.asarray(bad.tokens).copy()
+    tokens[0, 0] += 5  # shard 0 conjures tokens
+    bad = bad._replace(tokens=tokens)
+    final = jax.device_get(gs.run_storm(bad, np.asarray(prog.amounts),
+                                        np.asarray(prog.snap)))
+    assert int(final.error) & ERR_CONSERVATION

@@ -52,8 +52,9 @@ def main() -> int:
     for case in range(args.cases):
         rng = random.Random(args.seed_base + case)
         n = rng.randrange(4, 20)
-        spec = (scale_free(n, 2, seed=case, tokens=80) if case % 2
-                else erdos_renyi(max(n, 5), 2.5, seed=case, tokens=80))
+        gseed = args.seed_base + case  # graphs vary with --seed-base too
+        spec = (scale_free(n, 2, seed=gseed, tokens=80) if case % 2
+                else erdos_renyi(max(n, 5), 2.5, seed=gseed, tokens=80))
         topo = DenseTopology(spec)
         delay = rng.randrange(1, 5)
         phases = rng.randrange(5, 14)
