@@ -282,6 +282,17 @@ def run_worker(args) -> int:
         "delay": args.delay,
     }
     result.update(_memory_stats(dev))
+    if dev.platform != "tpu":
+        # an honest CPU/fallback number must not read as the chip's
+        # capability — point at the recorded device measurements. A
+        # deliberate CPU run (CLSIM_PLATFORM=cpu from the operator, not
+        # the orchestrator's fallback chain) is labeled as such.
+        deliberate = platform == "cpu" and "CLSIM_FALLBACK" not in os.environ
+        result["note"] = (
+            ("deliberate CPU run; " if deliberate
+             else "non-TPU fallback (device tunnel down?); ")
+            + "measured TPU rows live in BASELINE_MEASURED.jsonl "
+              "/ BASELINE.md")
     print(json.dumps(result), flush=True)
     return 0
 
@@ -307,7 +318,8 @@ def _attempts(args):
                 "--batch", str(min(args.batch, 64)),
                 "--phases", str(min(args.phases, 16)),
                 "--repeats", "1"]
-    yield "cpu", {"CLSIM_PLATFORM": "cpu"}, cpu_args, min(args.timeout, 600.0)
+    yield ("cpu", {"CLSIM_PLATFORM": "cpu", "CLSIM_FALLBACK": "1"},
+           cpu_args, min(args.timeout, 600.0))
 
 
 def _run_attempt(name, env_overrides, extra, timeout, argv):
@@ -371,6 +383,8 @@ def main(argv=None) -> int:
         "vs_baseline": 0.0,
         "platform": "none",
         "error": "all benchmark attempts failed (see stderr)",
+        "note": "measured TPU rows live in BASELINE_MEASURED.jsonl / "
+                "BASELINE.md",
     }), flush=True)
     return 0
 
