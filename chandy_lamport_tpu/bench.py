@@ -17,13 +17,36 @@ fix):
 
 * ``main()`` — orchestrator. Never imports jax. Runs the measurement in a
   subprocess and, when the backend fails to initialize or the attempt hangs,
-  retries with ``JAX_PLATFORMS=''`` (auto-choice) and finally
-  ``JAX_PLATFORMS=cpu`` with a reduced workload. ALWAYS prints exactly one
-  JSON line on stdout and exits 0. The JSON carries ``platform`` /
-  ``device_kind`` so a CPU fallback can never masquerade as a TPU number.
+  walks a fallback ladder that only abandons the TPU after giving it every
+  realistic shot (the round-3 official number was a CPU fallback because one
+  900s hang skipped straight past the TPU):
+
+    1. ``probe`` — a tiny jit in a subprocess with a short timeout. Answers
+       "is the device tunnel alive?" in ~15s instead of discovering a hang
+       after the full-attempt budget. A hung probe is retried once (tunnel
+       flakes are often transient), then re-asked with jax's automatic
+       platform choice (covers the round-1 plugin-init failure).
+    2. ``default`` — the full-size measurement. Retried once after a hang:
+       the persistent XLA compilation cache (enabled below) makes the
+       second attempt skip the multi-minute compile that dominated the
+       first, so a retry fits where the original attempt timed out.
+    3. ``tpu-small`` — a reduced-batch TPU attempt (batch<=256, 1 repeat).
+       A small TPU number beats a CPU number: it keeps the platform axis
+       honest even when the tunnel can't sustain the full-size window.
+    4. ``cpu`` — last resort, reduced workload, clearly labeled.
+
+  ALWAYS prints exactly one JSON line on stdout and exits 0. The JSON
+  carries ``platform`` / ``device_kind`` so a CPU fallback can never
+  masquerade as a TPU number.
 * ``worker`` mode (``--worker``) — the actual measurement; exit 3 means
   "backend init failed, retry me elsewhere", any other nonzero exit is a
   real failure (not retried on another platform).
+* ``probe`` mode (``--probe``) — jax.devices() + a tiny jit, then one JSON
+  line {"probe": "ok", "platform": ...}. Run under a short timeout.
+
+Every subprocess gets ``JAX_COMPILATION_CACHE_DIR`` pointed at an in-repo
+cache directory, so repeat invocations (the orchestrator's retry, or the
+driver re-running the bench) skip XLA compilation entirely.
 
 All diagnostics go to stderr; stdout carries exactly the one JSON line.
 """
@@ -39,9 +62,32 @@ import time
 
 EXIT_BACKEND_INIT = 3  # worker: backend unavailable -> orchestrator retries
 
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# persistent XLA compilation cache: the bench's dominant warmup cost is the
+# multi-minute XLA compile of the storm program; caching it in-repo means a
+# retry after a hang (or the driver's next invocation) pays seconds, not
+# minutes. Overridable so tests can isolate.
+CACHE_DIR = os.environ.get("CLSIM_CACHE_DIR",
+                           os.path.join(_PKG_ROOT, ".xla_cache"))
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _enable_compile_cache() -> None:
+    """Turn on jax's persistent compilation cache (call before first jit)."""
+    import jax
+
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        # default thresholds skip "cheap" compiles; the storm program's
+        # per-shape compiles are exactly what we want cached, always
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as exc:  # cache is an optimization, never a failure
+        log(f"compilation cache unavailable: {type(exc).__name__}: {exc}")
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -81,9 +127,41 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="capture a jax.profiler trace of one timed run into DIR")
     p.add_argument("--timeout", type=float, default=900.0,
-                   help="orchestrator: per-attempt wall-clock limit (s)")
+                   help="orchestrator: full-size-attempt wall-clock limit (s)")
+    p.add_argument("--probe-timeout", type=float, default=120.0,
+                   help="orchestrator: TPU liveness-probe limit (s); first "
+                        "device contact through the tunnel takes ~15-60s")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
     return p
+
+
+# ---------------------------------------------------------------------------
+# probe: is the device tunnel alive? (runs in a subprocess, short timeout)
+# ---------------------------------------------------------------------------
+
+def run_probe() -> int:
+    """Tiny jit on whatever platform CLSIM_PLATFORM selects; one JSON line."""
+    import jax
+
+    _enable_compile_cache()
+    platform = os.environ.get("CLSIM_PLATFORM")
+    if platform == "auto":
+        jax.config.update("jax_platforms", "")
+    elif platform:
+        jax.config.update("jax_platforms", platform)
+    try:
+        dev = jax.devices()[0]
+        import jax.numpy as jnp
+
+        val = int(jax.jit(lambda x: x + 1)(jnp.int32(41)))
+        assert val == 42
+    except Exception as exc:
+        log(f"probe failed: {type(exc).__name__}: {exc}")
+        return EXIT_BACKEND_INIT
+    print(json.dumps({"probe": "ok", "platform": dev.platform,
+                      "device_kind": dev.device_kind}), flush=True)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -102,6 +180,7 @@ def _memory_stats(dev) -> dict:
 def run_worker(args) -> int:
     import jax
 
+    _enable_compile_cache()
     # The env var JAX_PLATFORMS is not enough here: this image's TPU plugin
     # (axon) programmatically sets jax_platforms at import time, overriding
     # the environment. The orchestrator passes its platform choice via
@@ -298,82 +377,132 @@ def run_worker(args) -> int:
 
 
 # ---------------------------------------------------------------------------
-# orchestrator: subprocess attempts with platform fallback; exit 0 always
+# orchestrator: probe, then attempts with platform fallback; exit 0 always
 # ---------------------------------------------------------------------------
 
-def _attempts(args):
-    """(name, env-overrides, extra-cli-args, timeout) per attempt, in order.
+def _spawn(name, mode, env_overrides, extra, timeout, argv):
+    """Run one subprocess attempt.
 
-    The TPU attempt is bounded by --timeout because the plugin has been
-    observed to HANG in jax.devices() (not just fail fast) when the device
-    tunnel is down; the orchestrator kills it and falls back."""
-    yield "default", {}, [], args.timeout
-    # retry at full size with jax's automatic platform choice — covers
-    # transient plugin-init failures ("set JAX_PLATFORMS='' to automatically
-    # choose an available backend", the round-1 failure mode)
-    yield "auto", {"CLSIM_PLATFORM": "auto"}, [], args.timeout
-    # last resort: CPU with a reduced workload so it finishes; the JSON line
-    # carries platform=cpu so this can never masquerade as a TPU number
-    cpu_args = ["--nodes", str(min(args.nodes, 256)),
-                "--batch", str(min(args.batch, 64)),
-                "--phases", str(min(args.phases, 16)),
-                "--repeats", "1"]
-    yield ("cpu", {"CLSIM_PLATFORM": "cpu", "CLSIM_FALLBACK": "1"},
-           cpu_args, min(args.timeout, 600.0))
-
-
-def _run_attempt(name, env_overrides, extra, timeout, argv):
+    Returns (parsed_json|None, timed_out, retryable): ``retryable`` is True
+    for hangs and backend-init/crash exits (worth another attempt elsewhere);
+    a clean nonzero exit is a real measurement failure (invalid results,
+    repeated OOM) that a different-platform retry would only mask."""
     env = dict(os.environ)
     env.update(env_overrides)
     # the child must find the package regardless of the parent's cwd (the
     # repo-root wrapper's sys.path edit doesn't reach a subprocess)
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = _PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     cmd = [sys.executable, "-m", "chandy_lamport_tpu.bench",
-           "--worker"] + argv + extra
+           mode] + argv + extra
     log(f"--- attempt '{name}' (timeout {timeout:.0f}s): {' '.join(cmd)}")
+    t0 = time.perf_counter()
     try:
         proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
                               timeout=timeout)
     except subprocess.TimeoutExpired:
         log(f"attempt '{name}' timed out after {timeout:.0f}s")
         return None, True, True
+    dt = time.perf_counter() - t0
     out = proc.stdout.decode(errors="replace").strip().splitlines()
     if proc.returncode == 0 and out:
         try:
             parsed = json.loads(out[-1])
             parsed["attempt"] = name
+            log(f"attempt '{name}' ok in {dt:.0f}s")
             return parsed, False, False
         except json.JSONDecodeError:
             log(f"attempt '{name}': unparseable stdout {out[-1]!r}")
             return None, False, False
     retryable = proc.returncode in (EXIT_BACKEND_INIT, -6, -9, -11)
-    log(f"attempt '{name}' failed rc={proc.returncode} "
+    log(f"attempt '{name}' failed rc={proc.returncode} after {dt:.0f}s "
         f"(retryable={retryable})")
-    return None, retryable, False
+    return None, False, retryable
+
+
+def _find_live_platform(args):
+    """Liveness probe ladder. Returns (platform|None, env_overrides).
+
+    The TPU plugin has been observed to HANG in jax.devices() (not just
+    fail fast) when the device tunnel is down — and transient tunnel flakes
+    recover within a minute. So: probe, retry a hung probe once, then ask
+    jax's automatic platform choice (covers the round-1 plugin-init
+    failure, where JAX_PLATFORMS='' would have worked)."""
+    probe, timed_out, _ = _spawn("probe", "--probe", {}, [],
+                                 args.probe_timeout, [])
+    if probe is None and timed_out:
+        probe, timed_out, _ = _spawn("probe-retry", "--probe", {}, [],
+                                     args.probe_timeout, [])
+    if probe is not None:
+        return probe.get("platform"), {}
+    auto_env = {"CLSIM_PLATFORM": "auto"}
+    probe, _, _ = _spawn("probe-auto", "--probe", auto_env, [],
+                         args.probe_timeout, [])
+    if probe is not None:
+        return probe.get("platform"), auto_env
+    return None, {}
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     args = _parser().parse_args(argv)
+    if args.probe:
+        return run_probe()
     if args.worker:
         return run_worker(args)
 
-    argv = [a for a in argv if a != "--worker"]
-    saw_hang = False
-    for name, env_overrides, extra, timeout in _attempts(args):
-        if name == "auto" and saw_hang:
-            # the default attempt HUNG (plugin tunnel stuck) — a second
-            # full-size attempt would hang identically; go straight to CPU
-            log("skipping 'auto' attempt after a hang")
+    argv = [a for a in argv if a not in ("--worker", "--probe")]
+    platform, env = _find_live_platform(args)
+    log(f"probe verdict: platform={platform}")
+
+    plan = []
+    if platform == "tpu":
+        plan.append(("default", env, [], args.timeout, False))
+        # a hang or transient crash mid-measurement can still happen (tunnel
+        # dropped during the window); with the persistent compilation cache
+        # the retry skips the multi-minute compile, so a shorter budget
+        # suffices — still capped by the operator's --timeout
+        plan.append(("default-retry", env, [],
+                     min(args.timeout, max(args.timeout / 2, 450.0)), True))
+        small = ["--batch", str(min(args.batch, 256)), "--repeats", "1"]
+        plan.append(("tpu-small", env, small,
+                     min(args.timeout, 480.0), False))
+    elif platform is not None:
+        # a live non-TPU platform (CPU dev box, or a deliberate
+        # CLSIM_PLATFORM=cpu run — the probe inherits it) still gets the
+        # full-size attempt before any clamped fallback
+        plan.append(("default", env, [], args.timeout, False))
+    else:
+        # every probe hung: the tunnel may still recover mid-window (hung
+        # device calls complete when it does), so spend one full-size
+        # attempt on it before conceding — the official number must not be
+        # a CPU fallback just because the tunnel napped through the probes
+        plan.append(("tpu-blind", {}, [], args.timeout, False))
+    # last resort: CPU with a reduced workload so it finishes; the JSON line
+    # carries platform=cpu so this can never masquerade as a TPU number
+    cpu_args = ["--nodes", str(min(args.nodes, 256)),
+                "--batch", str(min(args.batch, 64)),
+                "--phases", str(min(args.phases, 16)),
+                "--repeats", "1"]
+    plan.append(("cpu", {"CLSIM_PLATFORM": "cpu", "CLSIM_FALLBACK": "1"},
+                 cpu_args, min(args.timeout, 600.0), False))
+
+    prev_retryable = False
+    for name, env_overrides, extra, timeout, only_after_retryable in plan:
+        if only_after_retryable and not prev_retryable:
+            # a clean rc!=0 failure is deterministic — a same-size retry
+            # would fail identically
+            log(f"skipping '{name}' (previous failure was not retryable)")
             continue
-        parsed, retryable, timed_out = _run_attempt(name, env_overrides,
-                                                    extra, timeout, argv)
-        saw_hang = saw_hang or timed_out
+        parsed, timed_out, retryable = _spawn(
+            name, "--worker", env_overrides, extra, timeout, argv)
         if parsed is not None:
             print(json.dumps(parsed), flush=True)
             return 0
-        if not retryable:
+        prev_retryable = timed_out or retryable
+        if not prev_retryable:
+            # a clean measurement failure (invalid results, repeated OOM) —
+            # a smaller or different-platform attempt would only mask it
+            # with a success-shaped number for a workload that failed
             break
     # every environment gets a parseable line and exit 0
     print(json.dumps({
