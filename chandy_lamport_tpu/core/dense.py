@@ -50,7 +50,8 @@ class DenseSim:
 
     def __init__(self, topology: TopologySpec,
                  delay_model: Union[DelayModel, JaxDelay],
-                 config: Optional[SimConfig] = None):
+                 config: Optional[SimConfig] = None,
+                 exact_impl: str = "cascade"):
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -60,7 +61,8 @@ class DenseSim:
         if self.delay.max_delay != self.config.max_delay:
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
-        self.kernel = TickKernel(self.topo, self.config, self.delay)
+        self.kernel = TickKernel(self.topo, self.config, self.delay,
+                                 exact_impl=exact_impl)
         self.state: DenseState = init_state(
             self.topo, self.config, self.delay.init_state())
         self._host_cache: Optional[DenseState] = None

@@ -161,16 +161,26 @@ class TickKernel:
     """
 
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
-                 marker_mode: str = "ring"):
+                 marker_mode: str = "ring", exact_impl: str = "cascade"):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
         "split" = markers live in [S, E] planes with FIFO order preserved
         by sequence numbers (the sync scheduler's fast path — ring content
-        is then only written on token sends, not every tick)."""
+        is then only written on token sends, not every tick).
+
+        exact_impl selects the bit-exact tick's formulation: "cascade"
+        (default) vectorizes token deliveries and folds only over marker
+        deliveries (_cascade_tick — O(E) + one sequential step per marker
+        delivered, instead of N scan steps per tick); "fold" is the
+        reference-literal N-step source scan (_tick), kept as the
+        specification form the cascade is differentially tested against."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
+        if exact_impl not in ("cascade", "fold"):
+            raise ValueError(f"unknown exact_impl {exact_impl!r}")
         self.marker_mode = marker_mode
+        self.exact_impl = exact_impl
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -238,7 +248,9 @@ class TickKernel:
         # silently truncate (record_dtype shrinks the log_amt[L, E] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
-        self.tick = jax.jit(self._tick, donate_argnums=0)
+        self._exact_tick = (self._cascade_tick if exact_impl == "cascade"
+                            else self._tick)
+        self.tick = jax.jit(self._exact_tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
         self.inject_snapshot = jax.jit(self._inject_snapshot, donate_argnums=0)
@@ -453,6 +465,112 @@ class TickKernel:
         s, _ = lax.scan(per_source, s, jnp.arange(self.topo.n, dtype=_i32))
         return s
 
+    # ---- the cascade tick: bit-exact semantics without the N-step fold ---
+
+    def _cascade_tick(self, s: DenseState) -> DenseState:
+        """Bit-identical to ``_tick`` (the reference fold, sim.go:71-95) but
+        O(E) vector work + one sequential step per MARKER delivered, instead
+        of an N-step scan per tick.
+
+        Why this is exact. The reference scans sources in sorted order,
+        delivering each source's first eligible head (sim.go:76-92). Three
+        facts make the N-step fold collapsible:
+
+        1. **Delivery selection is fixed at tick start.** Mid-tick pushes
+           carry ``receiveTime = time + 1 + delay > time`` (sim.go:100-102),
+           so they are never same-tick eligible, never change an existing
+           eligible head (pushes append at the tail), and an ineligible new
+           head of a previously-empty queue is scanned past exactly like the
+           empty queue was (sim.go:82-84 continues either way). Pops only
+           touch the delivering source's own queues. Hence the per-source
+           "first eligible head in dest order" is invariant over the fold
+           and can be computed once, vectorized.
+        2. **Token deliveries commute with each other.** Each selected edge
+           delivers at most one message (one delivery per source, distinct
+           edges), token credits are integer sums, and the shared-log append
+           touches each edge at most once per tick. Tokens draw no PRNG.
+        3. **Only markers need the fold.** Ordering-sensitive interactions
+           are marker→marker (has_local/rem, cascade re-broadcasts and
+           their PRNG draw order), marker→token (recording windows opened/
+           closed mid-tick), and token→marker (CreateLocalSnapshot freezes
+           the live balance, node.go:77). All three are preserved by
+           processing markers one at a time in source-rank order and
+           applying every pending token delivery with source rank < the
+           next marker's rank (vectorized) before it.
+
+        Edges are (src, dst)-sorted, so ascending edge index == the
+        reference's scan order, and at most one selected edge per source
+        means the pending-marker mask's first True edge IS the next marker
+        in fold order. A tick with no marker deliveries — the vast majority
+        — runs zero fold iterations. This is what makes the bit-exact
+        scheduler usable at N=8192 (the N-step scan program faulted the
+        device) and at production batch widths (VERDICT r3 #2/#3).
+
+        Transient-capacity edge vs the fold: the fold still holds a
+        not-yet-delivered selected head when an earlier marker's cascade
+        pushes onto the same ring, so at exactly-full capacity it flags
+        ERR_QUEUE_OVERFLOW (and clobbers the head) where this form — which
+        pops every selected head up front — still fits. The reference's
+        queues are unbounded (queue.go), so the cascade form is the more
+        faithful one at equal C; whenever neither impl flags, they are
+        bit-identical. Size C with SimConfig.for_workload as always.
+        """
+        C = self.cfg.queue_capacity
+        time = s.time + 1
+        s = s._replace(time=time)
+        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
+        head_hit = cc == s.q_head[:, None]                        # [E, C]
+        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1,
+                          dtype=_i32)
+        head_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
+                            dtype=_i32)
+        head_mk = jnp.any(head_hit & s.q_marker, axis=-1)
+        elig = (s.q_len > 0) & (head_rt <= time)
+        # first eligible edge per source in dest order (same O(E) prefix-
+        # count formulation as _sync_tick; edges are per-source contiguous)
+        elig_i = elig.astype(_i32)
+        before = jnp.cumsum(elig_i) - elig_i
+        sel = elig & (before == before[self._src_first])
+        # pop every selected head now: selection is invariant (fact 1), and
+        # captured head_data/head_mk carry the payloads
+        s = s._replace(q_head=(s.q_head + sel) % C,
+                       q_len=s.q_len - sel.astype(_i32))
+        tok_pend = sel & ~head_mk
+        mk_pend = sel & head_mk
+        amt_e = jnp.where(tok_pend, head_data, 0)
+        sid_e = head_data                       # marker payload: snapshot id
+        rows = self._rows_e
+
+        def apply_tokens(s, mask):
+            # HandleToken (node.go:174-185) for every masked edge at once:
+            # integer-exact segment-sum credits + the shared-log append
+            xs = jnp.take(jnp.where(mask, amt_e, 0), self._by_dst, axis=-1)
+            credit = self._segment_sums(xs, self._dst_lo, self._dst_hi)
+            log, cnt, err = log_append(
+                s.log_amt, s.rec_cnt, s.min_prot, s.recording,
+                mask, amt_e, self._rec_dtype, self._rec_limit,
+                self.cfg.max_recorded)
+            return s._replace(tokens=s.tokens + credit, log_amt=log,
+                              rec_cnt=cnt, error=s.error | err)
+
+        def cond(carry):
+            return jnp.any(carry[1])
+
+        def body(carry):
+            s, mk, tok = carry
+            found = jnp.any(mk)
+            e = jnp.argmax(mk)                  # lowest edge = lowest source
+            r = jnp.where(found, self._edge_src[e], _i32(self.topo.n))
+            tmask = tok & (self._edge_src < r)
+            s = apply_tokens(s, tmask)
+            s = lax.cond(found,
+                         lambda s: self._handle_marker(s, e, sid_e[e]),
+                         lambda s: s, s)
+            return s, mk & (rows != e), tok & ~tmask
+
+        s, _, tok_pend = lax.while_loop(cond, body, (s, mk_pend, tok_pend))
+        return apply_tokens(s, tok_pend)
+
     # ---- the synchronous tick (fast-path scheduler) ----------------------
 
     def _sync_tick(self, s: DenseState) -> DenseState:
@@ -590,7 +708,7 @@ class TickKernel:
         """n is a traced i32 so every distinct ``tick N`` count shares one
         compilation (fori_loop lowers to while_loop for dynamic bounds)."""
         return lax.fori_loop(jnp.int32(0), jnp.asarray(n, _i32),
-                             lambda _, s: self._tick(s), s)
+                             lambda _, s: self._exact_tick(s), s)
 
     # ---- event injection (sim.go:58-68) ---------------------------------
 
@@ -749,7 +867,7 @@ class TickKernel:
                              lambda _, s: tick_fn(s), s)
 
     def _drain_and_flush(self, s: DenseState) -> DenseState:
-        return self._drain_and_flush_with(s, self._tick)
+        return self._drain_and_flush_with(s, self._exact_tick)
 
     def _sync_drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._sync_tick)

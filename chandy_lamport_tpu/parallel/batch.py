@@ -112,9 +112,12 @@ class BatchedRunner:
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
-                 check_every: int = 0):
-        """scheduler: 'exact' = the reference's sequential source fold
-        (bit-exact, O(N) sequential steps per tick); 'sync' = simultaneous
+                 check_every: int = 0, exact_impl: str = "cascade"):
+        """scheduler: 'exact' = the reference's delivery semantics
+        (bit-exact; the default 'cascade' formulation is O(E) vector work
+        + one sequential step per marker delivered — ops/tick._cascade_tick
+        — while exact_impl='fold' is the reference-literal N-step source
+        scan kept as the specification form); 'sync' = simultaneous
         delivery (deterministic, protocol-equivalent, O(E) vectorized work
         per tick — the production/benchmark path, ops/tick._sync_tick).
 
@@ -141,9 +144,10 @@ class BatchedRunner:
         # by ticks); exact needs the unified ring for push-order PRNG draws
         self.kernel = TickKernel(
             self.topo, self.config, self.delay,
-            marker_mode="split" if scheduler == "sync" else "ring")
+            marker_mode="split" if scheduler == "sync" else "ring",
+            exact_impl=exact_impl)
         if scheduler == "exact":
-            self._tick_fn = self.kernel._tick
+            self._tick_fn = self.kernel._exact_tick
             self._drain_fn = self.kernel._drain_and_flush
         else:
             self._tick_fn = self.kernel._sync_tick

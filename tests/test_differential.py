@@ -84,3 +84,86 @@ def test_parity_vs_dense_random(case_seed):
         assert ps.id == ds.id
         assert ps.token_map == ds.token_map
         assert ps.messages == ds.messages  # exact order, not just per-dest
+
+
+@pytest.mark.parametrize("case_seed", range(4))
+def test_cascade_vs_fold_exact_impls(case_seed):
+    """The two formulations of the bit-exact tick — the reference-literal
+    N-step source fold (ops/tick._tick) and the marker-cascade form
+    (ops/tick._cascade_tick) — must agree on everything observable,
+    INCLUDING the delay sampler's stream position (draws happen at the
+    same fold positions or the whole PRNG-order contract R4 is broken)."""
+    from chandy_lamport_tpu.core.dense import DenseSim
+
+    rng = random.Random(4400 + case_seed)
+    topo = random_strongly_connected(rng, rng.randrange(3, 10))
+    events = random_script(rng, topo, rng.randrange(15, 45))
+    cfg = SimConfig(queue_capacity=64, max_recorded=64)
+
+    sims, snaps = [], []
+    for impl in ("fold", "cascade"):
+        sim = DenseSim(topo, GoExactDelay(31 + case_seed), cfg,
+                       exact_impl=impl)
+        snaps.append(sim.run_events(events))
+        sims.append(sim)
+    f_sim, c_sim = sims
+    assert f_sim.node_tokens() == c_sim.node_tokens()
+    assert snaps[0] == snaps[1]
+    # same number of PRNG draws consumed at the same points -> identical
+    # final sampler state
+    import jax
+    import numpy as np
+
+    f_leaves = jax.tree_util.tree_leaves(f_sim._host().delay_state)
+    c_leaves = jax.tree_util.tree_leaves(c_sim._host().delay_state)
+    assert len(f_leaves) == len(c_leaves)
+    for a, b in zip(f_leaves, c_leaves):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_multi_source_recording_windows():
+    """Force what no golden fixture exercises (SURVEY.md §2.2/§4.3): ONE
+    snapshot recording in-flight messages on MULTIPLE channels into one
+    node, during concurrent snapshots — asserting the sorted-src flatten
+    (the determinization of finalizeSnapshot's map-order iteration,
+    reference node.go:188-195).
+
+    Construction (FixedDelay(5) makes it deterministic): a complete
+    digraph on {N1..N4}; snapshots start at N1 then N2 at t=0; markers
+    reach the other nodes at t=5; meanwhile every node keeps sending
+    tokens to N1 and N2, which arrive (delay 5) after the receivers'
+    local snapshots exist but before the senders' markers do — so both
+    snapshots record on all three inbound channels of their initiator,
+    with overlapping windows on the shared edges."""
+    from chandy_lamport_tpu.models.delay import FixedDelay
+
+    ids = ["N1", "N2", "N3", "N4"]
+    topo = TopologySpec([(n, 100) for n in ids],
+                        sorted((a, b) for a in ids for b in ids if a != b))
+    events = []
+    events.append(SnapshotEvent("N1"))
+    events.append(SnapshotEvent("N2"))
+    for burst in range(3):
+        for src in ids:
+            for dst in ("N1", "N2"):
+                if src != dst:
+                    events.append(PassTokenEvent(src, dst, burst + 1))
+        events.append(TickEvent(1))
+
+    p_snaps, p_sim = run_events("parity", topo, events, FixedDelay(5))
+    d_snaps, d_sim = run_events("jax", topo, events, FixedDelay(5),
+                                SimConfig(queue_capacity=64, max_recorded=64))
+
+    assert p_sim.node_tokens() == d_sim.node_tokens()
+    assert len(p_snaps) == len(d_snaps) == 2
+    for ps, ds in zip(p_snaps, d_snaps):
+        assert ps.token_map == ds.token_map
+        assert ps.messages == ds.messages  # exact order == sorted-src flatten
+        # the scenario's whole point: >1 channel recorded per snapshot
+        dest = "N1" if ps.id == 0 else "N2"
+        srcs = {m.src for m in ps.messages if m.dest == dest}
+        assert len(srcs) >= 2, f"snapshot {ps.id} recorded only {srcs}"
+        # per-destination messages must be grouped by src in sorted order
+        # (R9): the flatten emits each source's window contiguously
+        seq = [m.src for m in ps.messages if m.dest == dest]
+        assert seq == sorted(seq)
