@@ -63,10 +63,14 @@ ERROR_NAMES = {
     ERR_RECORD_OVERFLOW: "recorded-message capacity exceeded (raise SimConfig.max_recorded)",
     ERR_TOKEN_UNDERFLOW: "node sent more tokens than it had (reference log.Fatal, node.go:113-116)",
     ERR_TICK_LIMIT: "drain loop hit max_ticks (graph not strongly connected?)",
-    ERR_VALUE_OVERFLOW: "token amount exceeded a numeric-exactness bound: "
+    ERR_VALUE_OVERFLOW: "a value-range bound was exceeded: token amount "
                         ">= 2^24 on the sync scheduler's f32 reductions "
-                        "(use scheduler='exact'), or beyond the configured "
-                        "record_dtype range (use record_dtype='int32')",
+                        "(use scheduler='exact'), a recorded amount beyond "
+                        "the configured record_dtype range (use "
+                        "record_dtype='int32'), or an edge's token-push "
+                        "counter reached the FIFO merge-key bound "
+                        "(ops/tick.merge_key_limit — fewer tokens per edge "
+                        "or a smaller max_snapshots)",
     ERR_CONSERVATION: "in-run token-conservation check failed "
                       "(node balances + in-flight != initial total; "
                       "BatchedRunner check_every — the reference's "

@@ -173,13 +173,22 @@ class HashJaxDelay(JaxDelay):
     tick through HBM, while this mixer is a handful of VPU ops that XLA
     fuses straight into the receive-time consumer — no intermediate tensor.
 
-    State is ``(key u32, counter u32)``; a draw hashes the counter through
-    two mix rounds with the key injected between them
-    (``mix(mix(ctr) ^ key)``). Every element of every draw gets a distinct
-    counter, so draws are reproducible; init_batch_state gives each vmap
-    lane the key ``base_key ^ lane·odd`` — an injective map, so no two
-    lanes can ever share a key (and hence a stream), and lane 0 reproduces
-    the single-instance stream exactly.
+    State is ``(key u32, counter u32, epoch u32)``; a draw hashes the
+    counter through two mix rounds with the key — XORed with the mixed
+    epoch — injected between them (``mix(mix(ctr) ^ key ^ mix(epoch))``).
+    Every element of every draw gets a distinct counter, so draws are
+    reproducible; init_batch_state gives each vmap lane the key
+    ``base_key ^ lane·odd`` — an injective map, so no two lanes can ever
+    share a key (and hence a stream), and lane 0 reproduces the
+    single-instance stream exactly.
+
+    The epoch word extends the per-lane period beyond 2^32 draws: when the
+    counter wraps, the epoch increments and re-keys the stream instead of
+    silently replaying it (at the bench shape a lane draws ~(S+1)·E words
+    per tick, so 2^32 is reachable on long runs). Elements of one
+    draw_many that straddle the wrap get the post-wrap epoch, keeping
+    every (epoch, counter) pair unique. ``mix(0) == 0``, so epoch 0 is
+    stream-identical to the pre-epoch format.
     """
 
     _LANE_MULT = 0x85EBCA6B  # odd -> lane -> key is injective mod 2^32
@@ -194,30 +203,37 @@ class HashJaxDelay(JaxDelay):
         return _lowbias32(jnp.uint32((self.seed ^ 0x9E3779B9) & 0xFFFFFFFF))
 
     def init_state(self):
-        return (self._base_key(), jnp.uint32(0))
+        return (self._base_key(), jnp.uint32(0), jnp.uint32(0))
 
-    def _delays(self, key, idx):
-        return (_lowbias32(_lowbias32(idx) ^ key)
+    def _delays(self, key, idx, epoch):
+        return (_lowbias32(_lowbias32(idx) ^ key ^ _lowbias32(epoch))
                 % jnp.uint32(self.max_delay)).astype(jnp.int32)
 
     def draw(self, dstate, time):
-        key, ctr = dstate
-        return (time + 1 + self._delays(key, ctr),
-                (key, ctr + jnp.uint32(1)))
+        key, ctr, epoch = dstate
+        new_ctr = ctr + jnp.uint32(1)
+        return (time + 1 + self._delays(key, ctr, epoch),
+                (key, new_ctr, epoch + (new_ctr == 0)))
 
     def draw_many(self, dstate, time, shape):
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
-        key, ctr = dstate
+        key, ctr, epoch = dstate
         n = 1
         for dim in shape:
             n *= dim
         idx = ctr + jnp.arange(n, dtype=jnp.uint32).reshape(shape)
-        return time + 1 + self._delays(key, idx), (key, ctr + jnp.uint32(n))
+        # elements past a counter wrap belong to the next epoch, so every
+        # (epoch, counter) pair stays unique across the wrap
+        elem_epoch = epoch + (idx < ctr)
+        new_ctr = ctr + jnp.uint32(n)
+        return (time + 1 + self._delays(key, idx, elem_epoch),
+                (key, new_ctr, epoch + (new_ctr < ctr)))
 
     def init_batch_state(self, batch):
         lane_key = self._base_key() ^ (
             jnp.arange(batch, dtype=jnp.uint32) * jnp.uint32(self._LANE_MULT))
-        return (lane_key, jnp.zeros(batch, jnp.uint32))
+        return (lane_key, jnp.zeros(batch, jnp.uint32),
+                jnp.zeros(batch, jnp.uint32))
 
 
 def make_fast_delay(name: str, seed: int,

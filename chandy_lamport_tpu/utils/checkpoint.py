@@ -24,7 +24,13 @@ import numpy as np
 
 from chandy_lamport_tpu.core.state import DenseState
 
-_FORMAT_VERSION = 1
+# version history:
+#   1 — round-2 DenseState (q_seq/seq_next/m_seq/rec_len/rec_data leaves)
+#   2 — round-3 window-log/merge-key state (tok_pushed/mk_cnt/m_key/rec_cnt/
+#       min_prot/log_amt/rec_start/rec_end) + round-4 three-word hash-delay
+#       state; old checkpoints get the unsupported-version error instead of
+#       a misleading leaf-count mismatch
+_FORMAT_VERSION = 2
 
 
 def save_state(path: str, state: DenseState, meta: dict | None = None) -> None:

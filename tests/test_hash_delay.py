@@ -60,12 +60,33 @@ def test_hash_delay_lane_keys_injective_and_lane0_matches_single():
     injective mod 2^32), and lane 0 reproduces the single-instance
     stream."""
     d = HashJaxDelay(seed=42)
-    keys, ctrs = d.init_batch_state(4096)
+    keys, ctrs, epochs = d.init_batch_state(4096)
     assert len(np.unique(np.asarray(keys))) == 4096
     assert int(np.asarray(ctrs).sum()) == 0
     single, _ = d.draw_many(d.init_state(), jnp.int32(5), 64)
-    lane0, _ = d.draw_many((keys[0], ctrs[0]), jnp.int32(5), 64)
+    lane0, _ = d.draw_many((keys[0], ctrs[0], epochs[0]), jnp.int32(5), 64)
     np.testing.assert_array_equal(np.asarray(single), np.asarray(lane0))
+
+
+def test_hash_delay_counter_wrap_rekeys_stream():
+    """ADVICE r3: the uint32 counter wrapping must NOT silently replay the
+    per-lane stream — the epoch word re-keys it. Elements of one draw_many
+    straddling the wrap get the post-wrap epoch, and the post-wrap stream
+    differs from the epoch-0 stream at the same counters."""
+    d = HashJaxDelay(seed=5)
+    key, _, _ = d.init_state()
+    near_wrap = (key, jnp.uint32(2**32 - 4), jnp.uint32(0))
+    _, (_, ctr2, ep2) = d.draw_many(near_wrap, jnp.int32(0), 8)
+    assert int(ctr2) == 4 and int(ep2) == 1          # wrapped once
+    # the post-wrap draws run at epoch 1 — same key, same counters 0..N,
+    # different stream than epoch 0 (256 draws can't all coincide)
+    rts_long, _ = d.draw_many(near_wrap, jnp.int32(0), 260)
+    epoch0_long, _ = d.draw_many(d.init_state(), jnp.int32(0), 256)
+    assert not np.array_equal(np.asarray(rts_long)[4:],
+                              np.asarray(epoch0_long))
+    # scalar draw across the wrap advances the epoch too
+    _, st = d.draw((key, jnp.uint32(2**32 - 1), jnp.uint32(0)), jnp.int32(0))
+    assert int(st[1]) == 0 and int(st[2]) == 1
 
 
 def test_hash_delay_distinct_seeds_distinct_streams():
