@@ -19,50 +19,10 @@ from chandy_lamport_tpu.config import SimConfig
 from chandy_lamport_tpu.core.spec import PassTokenEvent, SnapshotEvent, TickEvent
 from chandy_lamport_tpu.models.delay import GoExactDelay
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
-
-
-def random_strongly_connected(rng: random.Random, n: int) -> TopologySpec:
-    """Ring (guarantees strong connectivity — snapshot completion requires it,
-    sim.go:116-117) + random extra arcs; node ids deliberately collide
-    lexicographically (N1, N10, N2...) to exercise the sort rule R1."""
-    ids = [f"N{i + 1}" for i in range(n)]
-    nodes = [(nid, rng.randrange(50, 200)) for nid in ids]
-    order = ids[:]
-    rng.shuffle(order)
-    links = {(order[i], order[(i + 1) % n]) for i in range(n)}
-    for _ in range(rng.randrange(0, 2 * n)):
-        a, b = rng.sample(ids, 2)
-        links.add((a, b))
-    return TopologySpec(nodes, sorted(links))
-
-
-def random_script(rng: random.Random, topo: TopologySpec, n_events: int):
-    """Random sends/snapshots/ticks. Send amounts stay within a pessimistic
-    balance floor (credits ignored) so the reference's insufficient-balance
-    fatal (node.go:113-116) can never fire."""
-    floor = {nid: tok for nid, tok in topo.nodes}
-    out = {}
-    for s, d in topo.links:
-        out.setdefault(s, []).append(d)
-    events = []
-    snapshots = 0
-    for _ in range(n_events):
-        r = rng.random()
-        if r < 0.5:
-            src = rng.choice(list(out))
-            dest = rng.choice(out[src])
-            amt = rng.randrange(1, 4)
-            if floor[src] >= amt:
-                floor[src] -= amt
-                events.append(PassTokenEvent(src, dest, amt))
-        elif r < 0.7 and snapshots < 12:
-            events.append(SnapshotEvent(rng.choice([n for n, _ in topo.nodes])))
-            snapshots += 1
-        else:
-            events.append(TickEvent(rng.randrange(1, 4)))
-    if snapshots == 0:
-        events.append(SnapshotEvent(topo.nodes[0][0]))
-    return events
+from chandy_lamport_tpu.utils.randgen import (
+    random_script,
+    random_strongly_connected,
+)
 
 
 @pytest.mark.parametrize("case_seed", range(8))

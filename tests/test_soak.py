@@ -1,0 +1,23 @@
+"""Smoke wiring for the soak battery (tools/soak.py): every engine runs a
+small randomized sample in CI so a representation change cannot silently
+break an engine the fixed-seed suites don't reach. The deep battery is the
+tool itself (--cases 12+ per engine)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_soak_all_engines_small():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+         "--engine", "all", "--cases", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=900)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    result = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert result["failed_cases"] == []
+    assert result["matched"] == 6
+    assert sorted(result["engines"]) == ["exact", "shard", "sync"]

@@ -29,8 +29,23 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# the BASELINE.md ladder configs as one-flag presets ("max instances per
+# ladder config", VERDICT r3 #6); start batches sized so the doubling walk
+# reaches the boundary in a few probes
+PRESETS = {
+    "northstar": dict(graph="ring", nodes=10, max_snapshots=2, start=1 << 18),
+    "config2": dict(graph="ring", nodes=10, max_snapshots=8, start=1 << 16),
+    "config3": dict(graph="er", nodes=256, max_snapshots=8, start=1 << 12),
+    "config4": dict(graph="sf", nodes=1024, max_snapshots=8, start=1 << 10),
+    "config5": dict(graph="sf", nodes=8192, max_snapshots=8, start=1 << 7),
+}
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None,
+                   help="a BASELINE.md ladder config (overrides "
+                        "--graph/--nodes/--max-snapshots/--start)")
     p.add_argument("--nodes", type=int, default=1024)
     p.add_argument("--graph", choices=["sf", "ring", "er"], default="sf")
     p.add_argument("--attach", type=int, default=2)
@@ -40,6 +55,12 @@ def main() -> None:
     p.add_argument("--record-dtype", choices=["int32", "int16"],
                    default="int32")
     args = p.parse_args()
+    if args.preset:
+        # presets fill flags the user left at their defaults; explicit
+        # flags (e.g. a custom --start) win over the preset
+        for k, v in PRESETS[args.preset].items():
+            if getattr(args, k) == p.get_default(k):
+                setattr(args, k, v)
 
     platform = os.environ.get("CLSIM_PLATFORM")
     import jax
@@ -138,12 +159,16 @@ def main() -> None:
         "unit": "instances",
         "platform": dev.platform,
         "device_kind": dev.device_kind,
+        "preset": args.preset,
         "graph": args.graph,
         "nodes": args.nodes,
         "max_snapshots": args.max_snapshots,
         "record_dtype": args.record_dtype,
         "footprint_bytes_per_instance": per,
         "resident_gb_at_max": round(per * lo / 1e9, 2),
+        # concurrent snapshot slots resident at the max batch — the literal
+        # second axis of the north-star metric
+        "max_concurrent_snapshot_slots": lo * args.max_snapshots,
     }
     result.update(stats)
     print(json.dumps(result), flush=True)

@@ -1,16 +1,29 @@
 #!/usr/bin/env python
-"""Randomized oracle-differential soak for the sync scheduler.
+"""Randomized oracle-differential soak battery for ALL THREE engines.
 
-CI's differential suites (tests/test_sync_differential.py,
-tests/test_bf16_and_capacity.py) run a handful of fixed seeds; this tool
-drives an arbitrary number of random (graph, program, delay) combinations
-through the dense sync kernel and the independent SyncOracle and demands
-exact agreement on balances, time, and every snapshot's per-edge recorded
-window — the deep-confidence battery for representation changes (window
-log, merge keys, split markers). Each case also runs the in-run
-conservation sanitizer (check_every).
+CI's differential suites run a handful of fixed seeds; this tool drives an
+arbitrary number of random (graph, program, delay) combinations through each
+engine against its independent oracle and demands exact agreement — the
+deep-confidence battery for representation changes (window log, merge keys,
+split markers, the cascade tick). The invariant source is the reference's
+checkTokens + assertEqual (test_common.go:222-328); the comparisons here are
+stronger (exact per-edge windows / exact message order).
 
-Usage: python tools/soak.py [--cases N] [--seed-base S]
+Engines (--engine, default "all"):
+  sync   dense sync kernel (ops/tick._sync_tick) vs the independent
+         SyncOracle (core/syncsim), fixed delays, window-level comparison,
+         with the in-run conservation sanitizer on (check_every).
+  exact  dense bit-exact kernel (the cascade tick) vs the pure-Python
+         parity backend (core/parity) on random event scripts, alternating
+         GoExact and Fixed delay models — exact snapshot and message-order
+         equality plus final balances.
+  shard  graph-sharded sync runner (parallel/graphshard) vs the unsharded
+         dense sync kernel at random shard counts on the forced CPU mesh —
+         bit-equality of balances, frozen maps, completion, and every
+         per-(snapshot, edge) recorded window after undoing the shard
+         edge partition.
+
+Usage: python tools/soak.py [--engine E] [--cases N] [--seed-base S]
 Prints one JSON line; exit 1 on any mismatch.
 """
 
@@ -26,17 +39,30 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument("--cases", type=int, default=24)
-    p.add_argument("--seed-base", type=int, default=9000)
-    args = p.parse_args()
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
 
+
+def _random_storm(rng, topo, phases, n_snaps_max):
+    import numpy as np
+
+    amounts = np.zeros((phases, topo.e), np.int32)
+    floor = topo.tokens0.astype(np.int64).copy()
+    for ph in range(phases):
+        for e in rng.sample(range(topo.e), k=max(1, topo.e // 2)):
+            src = int(topo.edge_src[e])
+            if floor[src] >= 2:
+                amounts[ph, e] += 1
+                floor[src] -= 1
+    n_snaps = rng.randrange(1, n_snaps_max)
+    snap = np.full((phases, n_snaps), -1, np.int32)
+    for j in range(n_snaps):
+        snap[rng.randrange(phases), j] = rng.randrange(topo.n)
+    return amounts, snap
+
+
+def soak_sync(case: int, seed_base: int) -> bool:
     import jax
-
-    # the env var alone cannot override this image's TPU plugin; a soak is
-    # CPU work and must not hang when the device tunnel is down
-    jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     from chandy_lamport_tpu.config import SimConfig
@@ -47,66 +73,187 @@ def main() -> int:
     from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
 
+    rng = random.Random(seed_base + case)
+    n = rng.randrange(4, 20)
+    gseed = seed_base + case  # graphs vary with --seed-base too
+    spec = (scale_free(n, 2, seed=gseed, tokens=80) if case % 2
+            else erdos_renyi(max(n, 5), 2.5, seed=gseed, tokens=80))
+    topo = DenseTopology(spec)
+    delay = rng.randrange(1, 5)
+    phases = rng.randrange(5, 14)
+    amounts, snap = _random_storm(rng, topo, phases, 4)
+
+    runner = BatchedRunner(
+        spec, SimConfig(queue_capacity=32, max_recorded=128, max_snapshots=8),
+        FixedJaxDelay(delay), batch=1, scheduler="sync", check_every=3)
+    final = jax.device_get(
+        runner.run_storm(runner.init_batch(), (amounts, snap)))
+    lane = jax.tree_util.tree_map(lambda x: x[0], final)
+
+    oracle = SyncOracle(topo, FixedDelay(delay))
+    for ph in range(phases):
+        oracle.bulk_send([int(a) for a in amounts[ph]])
+        nodes = [int(x) for x in snap[ph] if x >= 0]
+        if nodes:
+            oracle.start_snapshots(nodes)
+        oracle.tick()
+    oracle.drain_and_flush()
+
+    ok = (int(lane.error) == 0
+          and oracle.tokens == [int(t) for t in lane.tokens]
+          and oracle.time == int(lane.time))
+    if ok:
+        for sid in range(int(lane.next_sid)):
+            for e in range(topo.e):
+                if (oracle.recorded[sid].get(e, [])
+                        != recorded_window(lane, sid, e)):
+                    ok = False
+    log(f"sync case {case}: {'ok' if ok else 'MISMATCH'} "
+        f"(n={topo.n} e={topo.e} delay={delay} phases={phases})")
+    return ok
+
+
+def soak_exact(case: int, seed_base: int) -> bool:
+    from chandy_lamport_tpu.api import run_events
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.delay import FixedDelay, GoExactDelay
+    from chandy_lamport_tpu.utils.randgen import (
+        random_script,
+        random_strongly_connected,
+    )
+
+    rng = random.Random(seed_base + 50_000 + case)
+    topo = random_strongly_connected(rng, rng.randrange(3, 14))
+    events = random_script(rng, topo, rng.randrange(10, 50))
+    cfg = SimConfig(queue_capacity=64, max_recorded=128)
+    # alternate the two delay models the exact scheduler must serve: the
+    # draw-order-sensitive Go stream and the stateless fixed model
+    mk_delay = ((lambda: GoExactDelay(seed_base + case)) if case % 2
+                else (lambda: FixedDelay(1 + case % 5)))
+
+    p_snaps, p_sim = run_events("parity", topo, events, mk_delay())
+    d_snaps, d_sim = run_events("jax", topo, events, mk_delay(), cfg)
+
+    ok = (p_sim.node_tokens() == d_sim.node_tokens()
+          and p_sim.total_tokens() == d_sim.total_tokens()
+          and len(p_snaps) == len(d_snaps))
+    if ok:
+        for ps, ds in zip(p_snaps, d_snaps):
+            if not (ps.id == ds.id and ps.token_map == ds.token_map
+                    and ps.messages == ds.messages):
+                ok = False
+    log(f"exact case {case}: {'ok' if ok else 'MISMATCH'} "
+        f"(n={len(topo.nodes)} events={len(events)} "
+        f"delay={'go' if case % 2 else 'fixed'})")
+    return ok
+
+
+def soak_shard(case: int, seed_base: int) -> bool:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.core.state import recorded_window
+    from chandy_lamport_tpu.models.workloads import erdos_renyi
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+
+    rng = random.Random(seed_base + 90_000 + case)
+    shards = rng.choice([s for s in (1, 2, 4, 8)
+                         if s <= len(jax.devices())][1:] or [1])
+    nl = rng.randrange(2, 6)           # nodes per shard
+    n = shards * nl
+    spec = erdos_renyi(n, 2.5, seed=seed_base + case, tokens=80)
+    cfg = SimConfig(queue_capacity=32, max_snapshots=8, max_recorded=64)
+    delay = rng.randrange(1, 5)
+    phases = rng.randrange(5, 14)
+
+    ref = BatchedRunner(spec, cfg, FixedJaxDelay(delay), batch=1,
+                        scheduler="sync")
+    amounts, snap = _random_storm(rng, ref.topo, phases, 4)
+    ref_final = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0],
+        jax.device_get(ref.run_storm(ref.init_batch(), (amounts, snap))))
+
+    mesh = Mesh(np.array(jax.devices()[:shards]), ("graph",))
+    gs = GraphShardedRunner(spec, cfg, mesh, fixed_delay=delay)
+    final = jax.device_get(gs.run_storm(gs.init_state(), amounts, snap))
+
+    ok = (int(final.error) == 0 == int(ref_final.error)
+          and int(final.time) == int(ref_final.time)
+          and np.array_equal(final.tokens.reshape(-1), ref_final.tokens)
+          and np.array_equal(final.completed, ref_final.completed))
+    if ok:
+        # undo the shard edge partition, then compare every recorded window
+        shard_of = gs.topo.edge_src // gs.nl
+        counts = [int((shard_of == p).sum()) for p in range(shards)]
+        perm = [i for p in range(shards)
+                for i in range(gs.topo.e) if shard_of[i] == p]
+        frozen = np.concatenate(
+            [final.frozen[p] for p in range(shards)], axis=-1)
+        ok = np.array_equal(frozen, ref_final.frozen)
+        from types import SimpleNamespace
+
+        for sid in range(int(ref_final.next_sid)):
+            if not ok:
+                break
+            gi = 0
+            for p in range(shards):
+                shard = SimpleNamespace(
+                    log_amt=final.log_amt[p], rec_cnt=final.rec_cnt[p],
+                    rec_start=final.rec_start[p], rec_end=final.rec_end[p],
+                    recording=final.recording[p])
+                for el in range(counts[p]):
+                    if (recorded_window(shard, sid, el)
+                            != recorded_window(ref_final, sid, perm[gi])):
+                        ok = False
+                    gi += 1
+    log(f"shard case {case}: {'ok' if ok else 'MISMATCH'} "
+        f"(n={n} shards={shards} delay={delay} phases={phases})")
+    return ok
+
+
+ENGINES = {"sync": soak_sync, "exact": soak_exact, "shard": soak_shard}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--engine", choices=[*ENGINES, "all"], default="all")
+    p.add_argument("--cases", type=int, default=12,
+                   help="cases per engine")
+    p.add_argument("--seed-base", type=int, default=9000)
+    args = p.parse_args(argv)
+
+    # the shard engine needs a multi-device mesh; harmless if jax is already
+    # initialized (then the caller — e.g. the pytest conftest — set it)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    # the env var alone cannot override this image's TPU plugin; a soak is
+    # CPU work and must not hang when the device tunnel is down
+    jax.config.update("jax_platforms", "cpu")
+    # the exact engine's GoExact stream needs 64-bit ints under jit
+    jax.config.update("jax_enable_x64", True)
+
+    engines = list(ENGINES) if args.engine == "all" else [args.engine]
     t0 = time.perf_counter()
     fails = []
-    for case in range(args.cases):
-        rng = random.Random(args.seed_base + case)
-        n = rng.randrange(4, 20)
-        gseed = args.seed_base + case  # graphs vary with --seed-base too
-        spec = (scale_free(n, 2, seed=gseed, tokens=80) if case % 2
-                else erdos_renyi(max(n, 5), 2.5, seed=gseed, tokens=80))
-        topo = DenseTopology(spec)
-        delay = rng.randrange(1, 5)
-        phases = rng.randrange(5, 14)
-        amounts = np.zeros((phases, topo.e), np.int32)
-        floor = topo.tokens0.astype(np.int64).copy()
-        for ph in range(phases):
-            for e in rng.sample(range(topo.e), k=max(1, topo.e // 2)):
-                src = int(topo.edge_src[e])
-                if floor[src] >= 2:
-                    amounts[ph, e] += 1
-                    floor[src] -= 1
-        n_snaps = rng.randrange(1, 4)
-        snap = np.full((phases, n_snaps), -1, np.int32)
-        for j in range(n_snaps):
-            snap[rng.randrange(phases), j] = rng.randrange(topo.n)
-
-        runner = BatchedRunner(
-            spec, SimConfig(queue_capacity=32, max_recorded=128,
-                            max_snapshots=8),
-            FixedJaxDelay(delay), batch=1, scheduler="sync", check_every=3)
-        final = jax.device_get(
-            runner.run_storm(runner.init_batch(), (amounts, snap)))
-        lane = jax.tree_util.tree_map(lambda x: x[0], final)
-
-        oracle = SyncOracle(topo, FixedDelay(delay))
-        for ph in range(phases):
-            oracle.bulk_send([int(a) for a in amounts[ph]])
-            nodes = [int(x) for x in snap[ph] if x >= 0]
-            if nodes:
-                oracle.start_snapshots(nodes)
-            oracle.tick()
-        oracle.drain_and_flush()
-
-        ok = (int(lane.error) == 0
-              and oracle.tokens == [int(t) for t in lane.tokens]
-              and oracle.time == int(lane.time))
-        if ok:
-            for sid in range(int(lane.next_sid)):
-                for e in range(topo.e):
-                    if (oracle.recorded[sid].get(e, [])
-                            != recorded_window(lane, sid, e)):
-                        ok = False
-        print(f"case {case}: {'ok' if ok else 'MISMATCH'} "
-              f"(n={topo.n} e={topo.e} delay={delay} phases={phases})",
-              file=sys.stderr, flush=True)
-        if not ok:
-            fails.append(case)
+    for engine in engines:
+        for case in range(args.cases):
+            if not ENGINES[engine](case, args.seed_base):
+                fails.append(f"{engine}:{case}")
 
     print(json.dumps({
         "metric": "soak_oracle_match",
-        "cases": args.cases,
-        "matched": args.cases - len(fails),
+        "engines": engines,
+        "cases_per_engine": args.cases,
+        "matched": len(engines) * args.cases - len(fails),
         "failed_cases": fails,
         "seconds": round(time.perf_counter() - t0, 1),
     }))
