@@ -122,6 +122,14 @@ def _parser() -> argparse.ArgumentParser:
                    help="log_amt[L,E] dtype; int16 halves it (amounts >= "
                         "2^15 flag ERR_VALUE_OVERFLOW; the bench sends "
                         "amount=1)")
+    p.add_argument("--window-dtype", choices=["uint16", "int32"],
+                   default="int32",
+                   help="rec_start/rec_end[S,E] dtype; uint16 stores the "
+                        "window counters mod 2^16 (decode-identical, "
+                        "SimConfig docstring) and halves the top profile "
+                        "line (the every-tick window-counter writes); "
+                        "default stays int32 until the TPU A/B "
+                        "(tools/r4_measure.py step 6) confirms the win")
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="fast-path delay sampler: the fused counter-hash "
                         "HashJaxDelay (default — same distribution as the "
@@ -287,6 +295,7 @@ def run_worker(args) -> int:
     cfg = SimConfig.for_workload(snapshots=args.snapshots,
                                  max_recorded=args.max_recorded,
                                  record_dtype=args.record_dtype,
+                                 window_dtype=args.window_dtype,
                                  split_markers=args.scheduler == "sync")
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
@@ -472,19 +481,27 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                             max_phases=args.phases))
     amounts, snap = np.asarray(prog.amounts), np.asarray(prog.snap)
 
-    final = None
+    from chandy_lamport_tpu.core.state import (
+        ERR_QUEUE_OVERFLOW,
+        ERR_RECORD_OVERFLOW,
+    )
+
+    recoverable = ERR_QUEUE_OVERFLOW | ERR_RECORD_OVERFLOW
     for cap_try in range(3):
         t0 = _time.perf_counter()
         final = runner.run_storm(runner.init_state(), amounts, snap)
         jax.block_until_ready(final)
         log(f"warmup (compile + run): {_time.perf_counter() - t0:.1f}s")
         bits = int(np.asarray(jax.device_get(final.error)))
+        del final  # double-residency guard (same as the batched path)
         if not bits:
             break
         for msg in decode_errors(bits):
             log(f"error bit: {msg}")
-        if cap_try == 2:
-            log("ERROR: error flags at final capacity — results invalid")
+        if (bits & ~recoverable) or cap_try == 2:
+            # a non-capacity bit is a real failure — doubling capacities
+            # would just recompile the giant-instance kernel to fail again
+            log("ERROR: error flags — results invalid")
             return 1
         cfg = dataclasses.replace(cfg, queue_capacity=2 * cfg.queue_capacity,
                                   max_recorded=2 * cfg.max_recorded)
@@ -493,6 +510,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         runner = GraphShardedRunner(spec, cfg, mesh, seed=17)
 
     times, ticks_seen = [], []
+    mem = {}
     for r in range(args.repeats):
         state = runner.init_state()
         jax.block_until_ready(state)
@@ -501,6 +519,9 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         jax.block_until_ready(final)
         dt = _time.perf_counter() - t0
         ticks = int(np.asarray(jax.device_get(final.time)))
+        if r == args.repeats - 1:   # capture while the state is resident
+            mem = _memory_stats(dev)
+        del state, final  # double-residency guard, per repeat
         times.append(dt)
         ticks_seen.append(ticks)
         log(f"run {r}: {dt:.3f}s, {ticks} ticks "
@@ -532,7 +553,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "max_recorded": cfg.max_recorded,
         "per_tick_ms": round(times[-1] / ticks_seen[-1] * 1e3, 3),
     }
-    result.update(_memory_stats(dev))
+    result.update(mem)
     if dev.platform != "tpu":
         result["note"] = ("non-TPU graphshard row (CPU-mesh relative cost "
                          "only); measured TPU rows live in "

@@ -100,7 +100,8 @@ def _cmd_storm(args) -> int:
     spec = gen()
     cfg = SimConfig.for_workload(
         snapshots=args.snapshots, max_recorded=args.max_recorded,
-        record_dtype=args.record_dtype, reduce_mode=args.reduce_mode,
+        record_dtype=args.record_dtype, window_dtype=args.window_dtype,
+        reduce_mode=args.reduce_mode,
         split_markers=args.scheduler == "sync",
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
@@ -172,6 +173,10 @@ def main(argv=None) -> int:
     ps.add_argument("--max-recorded", type=int, default=0,
                     help="per-edge log slots L; 0 = derived "
                          "(SimConfig.for_workload)")
+    ps.add_argument("--window-dtype", choices=["int32", "uint16"],
+                    default="int32",
+                    help="rec_start/rec_end plane dtype (uint16 = modular "
+                         "counters, SimConfig docstring)")
     ps.add_argument("--record-dtype", choices=["int32", "int16"],
                     default="int32")
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],

@@ -45,6 +45,14 @@ class SimConfig:
     # and roughly doubles the max batch; amounts beyond the dtype's range
     # fire ERR_VALUE_OVERFLOW instead of truncating silently.
     record_dtype: str = "int32"
+    # dtype of the per-(snapshot, edge) window-counter planes rec_start/
+    # rec_end. "uint16" stores them modulo 2^16 — sound because a window's
+    # LENGTH is bounded by max_recorded (ERR_RECORD_OVERFLOW past it) and
+    # the log index only needs j % L, so with L a power of two dividing
+    # 2^16 the modular counters decode identically; the i32 per-edge
+    # rec_cnt/min_prot keep overflow detection exact. Halves the top
+    # device-profile line (the every-tick [S, E] window-counter writes).
+    window_dtype: str = "int32"
     # dtype for 0/1 COUNT incidence matmuls (ops/tick.count_dtype): "auto"
     # picks bf16 on TPU when the degree bound proves counts exact (<= 256),
     # f32 otherwise; "bfloat16"/"float32" force either side of the gate
@@ -65,6 +73,17 @@ class SimConfig:
             raise ValueError("capacities must be positive")
         if self.record_dtype not in ("int32", "int16"):
             raise ValueError("record_dtype must be 'int32' or 'int16'")
+        if self.window_dtype not in ("int32", "uint16"):
+            raise ValueError("window_dtype must be 'int32' or 'uint16'")
+        if self.window_dtype == "uint16" and (
+                self.max_recorded > 32768
+                or self.max_recorded & (self.max_recorded - 1)):
+            # strictly below 2^16: a completely full window (length == L)
+            # must not alias length 0 under the mod-2^16 decode
+            raise ValueError(
+                "window_dtype='uint16' needs max_recorded to be a power of "
+                "two <= 32768 (modular window decode requires L | 2^16 and "
+                "full-window lengths < 2^16)")
         if self.count_dtype not in ("auto", "bfloat16", "float32"):
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
@@ -119,7 +138,12 @@ class SimConfig:
         # measured workload, and ERR_RECORD_OVERFLOW + the bench's
         # doubling retry keep any shortfall honest
         if not overrides.get("max_recorded"):
-            overrides["max_recorded"] = max(32, 4 * snapshots)
+            derived = max(32, 4 * snapshots)
+            if overrides.get("window_dtype") == "uint16":
+                # the modular window planes need L to be a power of two
+                # (an EXPLICIT non-power-of-two override still raises)
+                derived = 1 << (derived - 1).bit_length()
+            overrides["max_recorded"] = derived
         # an explicit queue_capacity override wins over the derived size
         capacity = overrides.pop("queue_capacity", (c + 7) // 8 * 8)
         return cls(queue_capacity=capacity, max_delay=max_delay, **overrides)

@@ -229,8 +229,8 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         rec_cnt=np.zeros(e, i32),
         min_prot=np.full(e, np.iinfo(np.int32).max, i32),
         log_amt=np.zeros((m, e), np.dtype(cfg.record_dtype)),
-        rec_start=np.zeros((s, e), i32),
-        rec_end=np.zeros((s, e), i32),
+        rec_start=np.zeros((s, e), np.dtype(cfg.window_dtype)),
+        rec_end=np.zeros((s, e), np.dtype(cfg.window_dtype)),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
         error=np.int32(0),
@@ -242,11 +242,23 @@ def recorded_window(host: DenseState, sid: int, eidx: int) -> List[int]:
     order: the [rec_start, rec_end) window of the edge's ring log
     (rec_end falls back to the live rec_cnt for a still-recording channel
     of an incomplete snapshot). THE definition of window decode — used by
-    decode_snapshot and every test oracle comparison."""
+    decode_snapshot and every test oracle comparison.
+
+    With SimConfig.window_dtype="uint16" the window planes hold the
+    counters modulo 2^16: the length recovers as (end - start) mod 2^16
+    (window lengths are bounded by L, guarded by ERR_RECORD_OVERFLOW via
+    the still-i32 rec_cnt/min_prot), and log positions as
+    (start + k) mod L — identical to the absolute-counter decode because
+    L divides 2^16 (enforced by SimConfig)."""
     lcap = host.log_amt.shape[-2]
     start = int(host.rec_start[sid, eidx])
     end = (int(host.rec_cnt[eidx]) if host.recording[sid, eidx]
            else int(host.rec_end[sid, eidx]))
+    if np.dtype(host.rec_start.dtype) != np.int32:   # modular window planes
+        bits = 8 * np.dtype(host.rec_start.dtype).itemsize
+        length = (end - start) & ((1 << bits) - 1)
+        return [int(host.log_amt[(start + k) % lcap, eidx])
+                for k in range(length)]
     return [int(host.log_amt[j % lcap, eidx]) for j in range(start, end)]
 
 

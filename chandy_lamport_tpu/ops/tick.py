@@ -136,8 +136,11 @@ def window_update(s, started_se, stopped_se, rec_cnt):
     (pass None for start-only injection paths), and advances min_prot.
     Shared by the dense and sharded kernels; returns the field dict for
     ``state._replace``. Recorded amounts need no prefix-sum snapshots:
-    decode reads them straight from the log window."""
-    cnt_b = jnp.expand_dims(rec_cnt, -2)
+    decode reads them straight from the log window. The counter is cast
+    to the plane dtype (window_dtype="uint16" stores it mod 2^16; decode
+    and the overflow guard stay exact — SimConfig docstring); min_prot
+    stays i32."""
+    cnt_b = jnp.expand_dims(rec_cnt, -2).astype(s.rec_start.dtype)
     out = dict(
         rec_start=jnp.where(started_se, cnt_b, s.rec_start),
         min_prot=jnp.where(jnp.any(started_se, axis=-2),
@@ -357,7 +360,8 @@ class TickKernel:
                 jnp.where(rec_mask, True, s.recording[sid])),
             # window start: this slot records the edge's arrivals from here
             rec_start=s.rec_start.at[sid].set(
-                jnp.where(rec_mask, s.rec_cnt, s.rec_start[sid])),
+                jnp.where(rec_mask, s.rec_cnt.astype(s.rec_start.dtype),
+                          s.rec_start[sid])),
             min_prot=jnp.where(rec_mask,
                                jnp.minimum(s.min_prot, s.rec_cnt),
                                s.min_prot),
@@ -403,7 +407,8 @@ class TickKernel:
             return s._replace(
                 recording=s.recording.at[sid, e].set(False),
                 rem=s.rem.at[sid, dst].add(-1),
-                rec_end=s.rec_end.at[sid, e].set(s.rec_cnt[e]),
+                rec_end=s.rec_end.at[sid, e].set(
+                    s.rec_cnt[e].astype(s.rec_end.dtype)),
             )
 
         s = lax.cond(~s.has_local[sid, dst], first, repeat, s)

@@ -133,8 +133,8 @@ class ShardedState(NamedTuple):
     rec_cnt: Any     # i32 [P, Em]
     min_prot: Any    # i32 [P, Em]
     log_amt: Any     # i32 [P, L, Em]
-    rec_start: Any   # i32 [P, S, Em]
-    rec_end: Any     # i32 [P, S, Em]
+    rec_start: Any   # window dtype [P, S, Em] (SimConfig.window_dtype)
+    rec_end: Any     # window dtype [P, S, Em]
     completed: Any   # i32 [S] (replicated)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
@@ -310,8 +310,8 @@ class GraphShardedRunner:
             rec_cnt=np.zeros((p, em), np.int32),
             min_prot=np.full((p, em), np.iinfo(np.int32).max, np.int32),
             log_amt=np.zeros((p, m, em), np.dtype(self.config.record_dtype)),
-            rec_start=np.zeros((p, s, em), np.int32),
-            rec_end=np.zeros((p, s, em), np.int32),
+            rec_start=np.zeros((p, s, em), np.dtype(cfg.window_dtype)),
+            rec_end=np.zeros((p, s, em), np.dtype(cfg.window_dtype)),
             completed=np.zeros(s, np.int32),
             delay_key=keys,
             error=np.int32(0),

@@ -71,11 +71,15 @@ def progress_counters(state: DenseState, cfg: SimConfig,
         "snapshots_pending": jnp.sum(started & ~complete),
         "nodes_finalized": jnp.sum(state.done_local),
         # per-(slot, edge) recorded count = its window length in the shared
-        # per-edge log (live windows extend to the current append counter)
+        # per-edge log (live windows extend to the current append counter);
+        # the subtraction runs in the window dtype, where uint16's modular
+        # wrap recovers the true length (bounded by L — state.py decode)
         "recorded_messages": jnp.sum(
-            jnp.where(state.recording,
-                      jnp.expand_dims(state.rec_cnt, -2), state.rec_end)
-            - state.rec_start),
+            (jnp.where(state.recording,
+                       jnp.expand_dims(
+                           state.rec_cnt.astype(state.rec_start.dtype), -2),
+                       state.rec_end)
+             - state.rec_start).astype(jnp.int32)),
         # bitwise OR over instances (jnp.max would drop bits when different
         # lanes carry different error flags)
         "error_bits": or_reduce(state.error),
@@ -87,8 +91,9 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 9·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + 18·E)
-    with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16)
+    footprint = 9·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + (10+2·win)·E)
+    with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16),
+    win = itemsize of SimConfig.window_dtype (4 default, 2 for uint16),
     and L = cfg.max_recorded (shared per-edge log slots).
 
     Dominant terms at bench shapes are the [S, E] recording/window/marker
@@ -100,6 +105,7 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     n, e = num_nodes, num_edges
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
     rec = np.dtype(cfg.record_dtype).itemsize
+    win = np.dtype(cfg.window_dtype).itemsize
     # q_* rings (marker/data/rtime) + head/len/tok_pushed/mk_cnt
     queues = e * c * (1 + 4 + 4) + e * (4 + 4 + 4 + 4)
     nodes = 4 * n                                       # tokens
@@ -108,7 +114,7 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     # per slot: started + [S,N] planes + recording + window counters
     # (start/end) + split-marker planes m_pending/m_rtime/m_key
     snaps = s * (1 + n * (1 + 4 + 4 + 1)
-                 + e * (1 + 4 * 2) + e * (1 + 4 + 4))
+                 + e * (1 + win * 2) + e * (1 + 4 + 4))
     scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
     return queues + nodes + rec_log + snaps + scalars
 

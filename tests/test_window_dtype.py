@@ -1,0 +1,101 @@
+"""SimConfig.window_dtype="uint16": the window-counter planes stored
+modulo 2^16. Decode must be identical to the int32 planes (the counters
+only ever matter through window LENGTHS, bounded by L, and log positions
+mod L with L | 2^16) — across the sync kernel, the exact kernel, and a
+synthetic counter wrap."""
+
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.api import run_events
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import DenseTopology, recorded_window
+from chandy_lamport_tpu.models.delay import GoExactDelay
+from chandy_lamport_tpu.models.workloads import (
+    erdos_renyi,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.randgen import (
+    random_script,
+    random_strongly_connected,
+)
+
+
+def test_config_rejects_bad_log_capacity():
+    with pytest.raises(ValueError, match="power of two"):
+        SimConfig(window_dtype="uint16", max_recorded=48)
+    SimConfig(window_dtype="uint16", max_recorded=64)  # fine
+
+
+def test_uint16_matches_int32_sync_storm():
+    spec = erdos_renyi(24, 2.5, seed=6, tokens=80)
+    finals = []
+    for wd in ("int32", "uint16"):
+        cfg = SimConfig(queue_capacity=32, max_recorded=32,
+                        max_snapshots=8, window_dtype=wd)
+        runner = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=2,
+                               scheduler="sync")
+        prog = storm_program(runner.topo, phases=10, amount=1,
+                             snapshot_phases=staggered_snapshots(runner.topo, 3))
+        final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+        assert int(final.error.sum()) == 0
+        finals.append((runner.topo, final))
+    (topo, a), (_, b) = finals
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    for lane in range(2):
+        la = jax.tree_util.tree_map(lambda x: x[lane], a)
+        lb = jax.tree_util.tree_map(lambda x: x[lane], b)
+        for sid in range(int(la.next_sid)):
+            for e in range(topo.e):
+                assert (recorded_window(la, sid, e)
+                        == recorded_window(lb, sid, e)), (lane, sid, e)
+
+
+@pytest.mark.parametrize("case_seed", [0, 1])
+def test_uint16_exact_scheduler_vs_parity(case_seed):
+    import random
+
+    rng = random.Random(7700 + case_seed)
+    topo = random_strongly_connected(rng, rng.randrange(3, 10))
+    events = random_script(rng, topo, rng.randrange(12, 35))
+    cfg = SimConfig(queue_capacity=64, max_recorded=64,
+                    window_dtype="uint16")
+    p_snaps, p_sim = run_events("parity", topo, events,
+                                GoExactDelay(55 + case_seed))
+    d_snaps, d_sim = run_events("jax", topo, events,
+                                GoExactDelay(55 + case_seed), cfg)
+    assert p_sim.node_tokens() == d_sim.node_tokens()
+    assert len(p_snaps) == len(d_snaps)
+    for ps, ds in zip(p_snaps, d_snaps):
+        assert ps.token_map == ds.token_map
+        assert ps.messages == ds.messages
+
+
+def test_recorded_window_decodes_across_uint16_wrap():
+    """A window straddling the 2^16 counter wrap decodes the same arrivals
+    an absolute counter would: length = (end - start) mod 2^16, positions
+    (start + k) mod L == absolute j mod L since L | 2^16."""
+    L = 16
+    true_start, length = 65533, 5        # absolute counters 65533..65538
+    amounts = [7, 11, 13, 17, 19]
+    log = np.zeros((L, 1), np.int32)
+    for k, amt in enumerate(amounts):
+        log[(true_start + k) % L, 0] = amt
+    host = SimpleNamespace(
+        log_amt=log,
+        rec_cnt=np.array([true_start + length], np.int32),
+        recording=np.array([[False]]),
+        rec_start=np.array([[true_start & 0xFFFF]], np.uint16),
+        rec_end=np.array([[(true_start + length) & 0xFFFF]], np.uint16),
+    )
+    assert int(host.rec_end[0, 0]) < int(host.rec_start[0, 0])  # wrapped
+    assert recorded_window(host, 0, 0) == amounts
+    # live window (still recording): end falls back to the i32 rec_cnt
+    host.recording[0, 0] = True
+    assert recorded_window(host, 0, 0) == amounts

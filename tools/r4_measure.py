@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""The round-4 TPU measurement plan, one command.
+
+Runs every row VERDICT r3 asked for against the live device and appends to
+``BASELINE_MEASURED.jsonl`` (same JSON contract as bench.py/ladder.py —
+every row carries platform/device_kind, clamped shapes are labeled):
+
+  1. sync ladder refresh (configs 2-5 + the literal 1M-instance north star)
+  2. cascade-exact ladder at FULL batches — the cascade tick (ops/tick
+     _cascade_tick) removes the N-step per-tick fold, so exact no longer
+     needs clamped batches, and N=8192 must now compile+run on device
+     (VERDICT r3 #2)
+  3. "exact semantics at scale": the reference scheduler with per-lane
+     hash-delay streams at production widths (VERDICT r3 #3)
+  4. graphshard overhead: config-4 shape, unsharded B=1 vs --graphshard 1
+     on the same chip (VERDICT r3 #4)
+  5. max-batch presets northstar/config3/config4 with the HBM axis
+     (VERDICT r3 #6)
+  6. window-dtype A/B at the headline config: uint16 window planes vs the
+     int32 default (VERDICT r3 #7 — the [S, E] window-counter writes are
+     the top profile line; flip the bench default if uint16 wins)
+
+Usage: python tools/r4_measure.py [--only 1,2,...] [--timeout S]
+Skips nothing silently: a failed row still appends its error JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_tool(name: str, script: str, extra: list, timeout: float, out: str) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, script)] + extra
+    log(f"--- {name}: {' '.join(cmd)}")
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, cwd=ROOT,
+                              timeout=timeout)
+        lines = proc.stdout.decode().strip().splitlines()
+        row = (json.loads(lines[-1]) if lines
+               else {"error": "no output", "rc": proc.returncode})
+    except subprocess.TimeoutExpired:
+        row = {"error": f"timed out after {timeout:.0f}s"}
+    except Exception as exc:  # a malformed row must not kill the plan
+        row = {"error": f"{type(exc).__name__}: {exc}"}
+    row["config"] = name
+    print(json.dumps(row), flush=True)
+    with open(out, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default="",
+                   help="comma-separated step numbers (default: all)")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="bench-internal full-size attempt budget")
+    p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
+    args = p.parse_args()
+    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 7))
+
+    def bench(name, extra):
+        # outer budget: probe ladder + attempts; bench always prints a line
+        return run_tool(name, "bench.py",
+                        extra + ["--timeout", str(args.timeout)],
+                        args.timeout * 3 + 600, args.out)
+
+    plan_sync = [
+        ("r4_northstar_ring10_1M", ["--graph", "ring", "--nodes", "10",
+                                    "--batch", "1048576", "--phases", "32",
+                                    "--snapshots", "2", "--repeats", "2"]),
+        ("r4_config2_ring10_sync", ["--graph", "ring", "--nodes", "10",
+                                    "--batch", "131072", "--phases", "32",
+                                    "--snapshots", "1"]),
+        ("r4_config3_er256_sync", ["--graph", "er", "--nodes", "256",
+                                   "--batch", "4096", "--phases", "32",
+                                   "--snapshots", "4"]),
+        ("r4_config4_sf1k_sync", ["--graph", "sf", "--nodes", "1024",
+                                  "--batch", "2048", "--phases", "32",
+                                  "--snapshots", "8"]),
+        ("r4_config5_sf8k_sync", ["--graph", "sf", "--nodes", "8192",
+                                  "--batch", "512", "--phases", "16",
+                                  "--snapshots", "8"]),
+    ]
+    # cascade exact at the SYNC batches — the whole point of the cascade
+    # (config 5 included: the N=8192 device fault must be gone; configs 2-3
+    # are covered by step 3's explicitly-labeled exact-at-scale rows, since
+    # bench's default delay is already the per-lane hash stream)
+    plan_exact = [
+        ("r4_config4_sf1k_exact", ["--graph", "sf", "--nodes", "1024",
+                                   "--batch", "2048", "--phases", "32",
+                                   "--snapshots", "8"]),
+        ("r4_config5_sf8k_exact", ["--graph", "sf", "--nodes", "8192",
+                                   "--batch", "512", "--phases", "16",
+                                   "--snapshots", "8"]),
+    ]
+
+    if 1 in only:
+        for name, extra in plan_sync:
+            bench(name, extra + ["--scheduler", "sync"])
+    if 2 in only:
+        for name, extra in plan_exact:
+            bench(name, extra + ["--scheduler", "exact"])
+    if 3 in only:
+        # "exact semantics at scale": reference scheduler, per-lane hash
+        # streams, production widths (the GoExact shared stream is only
+        # required for golden conformance)
+        bench("r4_exact_at_scale_ring10",
+              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
+               "--phases", "32", "--snapshots", "1",
+               "--scheduler", "exact", "--delay", "hash"])
+        bench("r4_exact_at_scale_er256",
+              ["--graph", "er", "--nodes", "256", "--batch", "4096",
+               "--phases", "32", "--snapshots", "4",
+               "--scheduler", "exact", "--delay", "hash"])
+    if 4 in only:
+        # collective-formulation tax: same shape, unsharded B=1 vs 1-shard
+        bench("r4_gshard_base_sf1k_b1",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "1",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "sync"])
+        bench("r4_gshard_1shard_sf1k",
+              ["--graph", "sf", "--nodes", "1024", "--graphshard", "1",
+               "--phases", "32", "--snapshots", "8"])
+    if 5 in only:
+        for preset in ("northstar", "config3", "config4"):
+            run_tool(f"r4_maxbatch_{preset}", "tools/maxbatch.py",
+                     ["--preset", preset, "--record-dtype", "int16"],
+                     3600.0, args.out)
+    if 6 in only:
+        # A/B the uint16 window planes at the headline config (the int32
+        # side is step 1's r4_config4_sf1k_sync row)
+        bench("r4_config4_sf1k_sync_win16",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "sync",
+               "--window-dtype", "uint16"])
+    log("r4 measurement plan complete")
+
+
+if __name__ == "__main__":
+    main()

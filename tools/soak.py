@@ -83,8 +83,10 @@ def soak_sync(case: int, seed_base: int) -> bool:
     phases = rng.randrange(5, 14)
     amounts, snap = _random_storm(rng, topo, phases, 4)
 
+    wd = rng.choice(["int32", "uint16"])
     runner = BatchedRunner(
-        spec, SimConfig(queue_capacity=32, max_recorded=128, max_snapshots=8),
+        spec, SimConfig(queue_capacity=32, max_recorded=128, max_snapshots=8,
+                        window_dtype=wd),
         FixedJaxDelay(delay), batch=1, scheduler="sync", check_every=3)
     final = jax.device_get(
         runner.run_storm(runner.init_batch(), (amounts, snap)))
@@ -125,7 +127,8 @@ def soak_exact(case: int, seed_base: int) -> bool:
     rng = random.Random(seed_base + 50_000 + case)
     topo = random_strongly_connected(rng, rng.randrange(3, 14))
     events = random_script(rng, topo, rng.randrange(10, 50))
-    cfg = SimConfig(queue_capacity=64, max_recorded=128)
+    cfg = SimConfig(queue_capacity=64, max_recorded=128,
+                    window_dtype=rng.choice(["int32", "uint16"]))
     # alternate the two delay models the exact scheduler must serve: the
     # draw-order-sensitive Go stream and the stateless fixed model
     mk_delay = ((lambda: GoExactDelay(seed_base + case)) if case % 2
@@ -166,7 +169,8 @@ def soak_shard(case: int, seed_base: int) -> bool:
     nl = rng.randrange(2, 6)           # nodes per shard
     n = shards * nl
     spec = erdos_renyi(n, 2.5, seed=seed_base + case, tokens=80)
-    cfg = SimConfig(queue_capacity=32, max_snapshots=8, max_recorded=64)
+    cfg = SimConfig(queue_capacity=32, max_snapshots=8, max_recorded=64,
+                    window_dtype=rng.choice(["int32", "uint16"]))
     delay = rng.randrange(1, 5)
     phases = rng.randrange(5, 14)
 
