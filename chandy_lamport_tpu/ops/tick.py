@@ -108,16 +108,15 @@ def merge_key_limit(max_snapshots: int) -> int:
     return (1 << 31) // merge_keymult(max_snapshots) - 1
 
 
-def log_append(log_amt, rec_cnt, min_prot, recording, tok_e, amt_e,
-               rec_dtype, rec_limit, log_slots: int):
-    """Shared-log append for one sync tick, vector form (DenseState
-    "Recording as windows"): append ``amt_e[e]`` to edge e's ring log when
-    a token delivered there (``tok_e``) and ANY slot records it. One
-    definition serves both the dense and the graph-sharded sync tick so
-    the two cannot drift. Returns (log_amt, rec_cnt, err_bits); the
-    caller folds err_bits into its error channel (psum'd on the sharded
-    path)."""
-    app_e = tok_e & jnp.any(recording, axis=-2)
+def log_append_masked(log_amt, rec_cnt, min_prot, app_e, amt_e,
+                      rec_dtype, rec_limit, log_slots: int):
+    """The shared-log write for a pre-computed append mask ``app_e`` (each
+    edge appends at most once per tick, so ``rec_cnt % log_slots`` is the
+    same whenever during the tick it is read). The cascade tick defers its
+    per-chunk appends into one call here — the mask must capture the
+    recording state at each token's fold position, NOT the end-of-tick
+    state (a window opened after a token must not swallow it), which is
+    why this takes the mask rather than re-deriving it."""
     pos_e = rec_cnt % log_slots
     ll = jnp.arange(log_slots, dtype=_i32)[:, None]
     new_cnt = rec_cnt + app_e.astype(_i32)
@@ -128,6 +127,20 @@ def log_append(log_amt, rec_cnt, min_prot, recording, tok_e, amt_e,
     log_amt = jnp.where(app_e[None, :] & (ll == pos_e[None, :]),
                         amt_e[None, :].astype(rec_dtype), log_amt)
     return log_amt, new_cnt, err
+
+
+def log_append(log_amt, rec_cnt, min_prot, recording, tok_e, amt_e,
+               rec_dtype, rec_limit, log_slots: int):
+    """Shared-log append for one sync tick, vector form (DenseState
+    "Recording as windows"): append ``amt_e[e]`` to edge e's ring log when
+    a token delivered there (``tok_e``) and ANY slot records it. One
+    definition serves both the dense and the graph-sharded sync tick so
+    the two cannot drift. Returns (log_amt, rec_cnt, err_bits); the
+    caller folds err_bits into its error channel (psum'd on the sharded
+    path)."""
+    return log_append_masked(log_amt, rec_cnt, min_prot,
+                             tok_e & jnp.any(recording, axis=-2), amt_e,
+                             rec_dtype, rec_limit, log_slots)
 
 
 def window_update(s, started_se, stopped_se, rec_cnt):
@@ -344,14 +357,19 @@ class TickKernel:
 
     # ---- protocol handlers (node.go) ------------------------------------
 
-    def _create_local(self, s: DenseState, sid, node, exclude_edge) -> DenseState:
+    def _create_local(self, s: DenseState, sid, node, exclude_edge,
+                      cnt_extra=0) -> DenseState:
         """CreateLocalSnapshot (node.go:58-84): freeze tokens, record all
         inbound links except the marker's own (exclude_edge == -1 for the
-        initiator case)."""
+        initiator case). ``cnt_extra`` ([E] i32 or 0) compensates for the
+        cascade tick's deferred log appends: windows must open at the
+        counter each edge WILL have once this tick's earlier-rank appends
+        land (0 from the fold/injection paths, whose rec_cnt is live)."""
         E = self.topo.e
         inbound = self._edge_dst == node
         rec_mask = inbound & (jnp.arange(E, dtype=_i32) != exclude_edge)
         links = self._in_degree[node] - jnp.asarray(exclude_edge >= 0, _i32)
+        cnt = s.rec_cnt + cnt_extra
         return s._replace(
             has_local=s.has_local.at[sid, node].set(True),
             frozen=s.frozen.at[sid, node].set(s.tokens[node]),
@@ -360,10 +378,10 @@ class TickKernel:
                 jnp.where(rec_mask, True, s.recording[sid])),
             # window start: this slot records the edge's arrivals from here
             rec_start=s.rec_start.at[sid].set(
-                jnp.where(rec_mask, s.rec_cnt.astype(s.rec_start.dtype),
+                jnp.where(rec_mask, cnt.astype(s.rec_start.dtype),
                           s.rec_start[sid])),
             min_prot=jnp.where(rec_mask,
-                               jnp.minimum(s.min_prot, s.rec_cnt),
+                               jnp.minimum(s.min_prot, cnt),
                                s.min_prot),
         )
 
@@ -389,15 +407,18 @@ class TickKernel:
             completed=s.completed.at[sid].add(jnp.asarray(fire, _i32)),
         )
 
-    def _handle_marker(self, s: DenseState, e, sid) -> DenseState:
+    def _handle_marker(self, s: DenseState, e, sid, cnt_extra=0) -> DenseState:
         """HandleMarker (node.go:149-171). First marker for sid at this node:
         create the local snapshot excluding the marker's link, then re-broadcast
         (node.StartSnapshot, node.go:198-212). Repeat marker: stop recording
-        that link. Either way, check finalization after (R8)."""
+        that link. Either way, check finalization after (R8). ``cnt_extra``
+        threads the cascade's deferred-append compensation to
+        _create_local; the repeat branch needs none (edge e delivered this
+        marker, so its own count has no pending append this tick)."""
         dst = self._edge_dst[e]
 
         def first(s):
-            s = self._create_local(s, sid, dst, e)
+            s = self._create_local(s, sid, dst, e, cnt_extra=cnt_extra)
             return self._broadcast_markers(s, dst, sid)
 
         def repeat(s):
@@ -546,35 +567,46 @@ class TickKernel:
         sid_e = head_data                       # marker payload: snapshot id
         rows = self._rows_e
 
-        def apply_tokens(s, mask):
-            # HandleToken (node.go:174-185) for every masked edge at once:
-            # integer-exact segment-sum credits + the shared-log append
+        def credit(s, mask):
+            # HandleToken's balance half (node.go:175), vectorized: cheap
+            # [E] -> [N] integer segment sums, applied eagerly per chunk so
+            # _create_local freezes the right balances (node.go:77)
             xs = jnp.take(jnp.where(mask, amt_e, 0), self._by_dst, axis=-1)
-            credit = self._segment_sums(xs, self._dst_lo, self._dst_hi)
-            log, cnt, err = log_append(
-                s.log_amt, s.rec_cnt, s.min_prot, s.recording,
-                mask, amt_e, self._rec_dtype, self._rec_limit,
-                self.cfg.max_recorded)
-            return s._replace(tokens=s.tokens + credit, log_amt=log,
-                              rec_cnt=cnt, error=s.error | err)
+            return s._replace(tokens=s.tokens + self._segment_sums(
+                xs, self._dst_lo, self._dst_hi))
 
+        # HandleToken's recording half is DEFERRED: each edge appends at
+        # most once per tick (at a fixed log position), so the heavy [L, E]
+        # log write happens once at the end under the accumulated mask —
+        # but the mask itself must be taken per chunk, against the
+        # recording state at that fold position (a window opened by a
+        # later marker must not swallow an earlier token), and
+        # _create_local opens windows at rec_cnt + pending appends.
         def cond(carry):
             return jnp.any(carry[1])
 
         def body(carry):
-            s, mk, tok = carry
+            s, mk, tok, app = carry
             found = jnp.any(mk)
             e = jnp.argmax(mk)                  # lowest edge = lowest source
             r = jnp.where(found, self._edge_src[e], _i32(self.topo.n))
             tmask = tok & (self._edge_src < r)
-            s = apply_tokens(s, tmask)
+            s = credit(s, tmask)
+            app = app | (tmask & jnp.any(s.recording, axis=-2))
             s = lax.cond(found,
-                         lambda s: self._handle_marker(s, e, sid_e[e]),
+                         lambda s: self._handle_marker(
+                             s, e, sid_e[e], cnt_extra=app.astype(_i32)),
                          lambda s: s, s)
-            return s, mk & (rows != e), tok & ~tmask
+            return s, mk & (rows != e), tok & ~tmask, app
 
-        s, _, tok_pend = lax.while_loop(cond, body, (s, mk_pend, tok_pend))
-        return apply_tokens(s, tok_pend)
+        s, _, tok_pend, app = lax.while_loop(
+            cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend)))
+        s = credit(s, tok_pend)
+        app = app | (tok_pend & jnp.any(s.recording, axis=-2))
+        log, cnt, err = log_append_masked(
+            s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
+            self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
+        return s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
 
     # ---- the synchronous tick (fast-path scheduler) ----------------------
 

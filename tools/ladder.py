@@ -83,13 +83,10 @@ def main() -> None:
     for name, extra in ladder:
         for sched in schedulers:
             run = list(extra)
-            if sched == "exact":
-                # the exact scheduler's per-tick lax.scan over N source
-                # slots costs ~8x the sync path's HBM (live scan carries);
-                # starting it at the sync batch just burns OOM-halving
-                # retries (and has crashed the device tunnel) — start small
-                b = run.index("--batch")
-                run[b + 1] = str(max(int(run[b + 1]) // 8, 8))
+            # (round 4) exact runs at the full sync batch: the cascade tick
+            # (ops/tick._cascade_tick) removed the N-step per-tick scan
+            # whose live carries cost ~8x the sync path's HBM and faulted
+            # the device at N=8192 — the old /8 clamp is gone
             if args.delay:
                 run += ["--delay", args.delay]
             row = bench(f"{name}_{sched}", run + ["--scheduler", sched],
