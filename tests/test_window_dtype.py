@@ -77,6 +77,30 @@ def test_uint16_exact_scheduler_vs_parity(case_seed):
         assert ps.messages == ds.messages
 
 
+def test_uint16_checkpoint_roundtrip(tmp_path):
+    """Checkpoint round-trip preserves the uint16 window planes (dtype is
+    validated leaf-by-leaf on restore)."""
+    from chandy_lamport_tpu.utils.checkpoint import load_state, save_state
+
+    spec = erdos_renyi(12, 2.5, seed=2, tokens=40)
+    cfg = SimConfig(queue_capacity=16, max_recorded=32, max_snapshots=4,
+                    window_dtype="uint16")
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=2,
+                           scheduler="sync")
+    prog = storm_program(runner.topo, phases=6, amount=1,
+                         snapshot_phases=staggered_snapshots(runner.topo, 2))
+    final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+    path = str(tmp_path / "w16.npz")
+    save_state(path, final, {"note": "uint16 windows"})
+    restored, meta = load_state(path, runner.init_batch())
+    assert meta["note"] == "uint16 windows"
+    assert np.dtype(np.asarray(restored.rec_start).dtype) == np.uint16
+    np.testing.assert_array_equal(np.asarray(restored.rec_start),
+                                  np.asarray(final.rec_start))
+    np.testing.assert_array_equal(np.asarray(restored.tokens),
+                                  np.asarray(final.tokens))
+
+
 def test_recorded_window_decodes_across_uint16_wrap():
     """A window straddling the 2^16 counter wrap decodes the same arrivals
     an absolute counter would: length = (end - start) mod 2^16, positions
