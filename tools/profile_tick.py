@@ -1,12 +1,17 @@
 #!/usr/bin/env python
-"""Op-level device profile of the sync tick at the bench shape.
+"""Op-level device profile of a bare tick at the bench shape.
 
-Captures a jax.profiler trace of jitted sync ticks with state resident on
+Captures a jax.profiler trace of jitted ticks with state resident on
 device (transfer-free, the same regime the bench measures), converts the
 xplane with xprof, and prints the top HLO ops by self time — the "name the
 dominant op" artifact BASELINE.md's optimization log cites.
+``--scheduler exact`` profiles the cascade tick instead of the sync tick
+(note: bare drained ticks deliver nothing, so for the cascade this shows
+the selection/credit floor; the marker-fold cost only appears under live
+traffic — use ``bench.py --profile`` for a full-storm trace).
 
 Usage: python tools/profile_tick.py [--nodes N] [--batch B] [--ticks K]
+       [--scheduler sync|exact] [--window-dtype int32|uint16]
        [--reduce-mode auto|matmul|segsum] [--out DIR]
 """
 
@@ -60,6 +65,9 @@ def main() -> None:
     p.add_argument("--ticks", type=int, default=20)
     p.add_argument("--reduce-mode", default="auto",
                    choices=["auto", "matmul", "segsum"])
+    p.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    p.add_argument("--window-dtype", choices=["int32", "uint16"],
+                   default="int32")
     p.add_argument("--snapshots", type=int, default=8)
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="same knob as bench --delay")
@@ -68,6 +76,12 @@ def main() -> None:
     args = p.parse_args()
 
     import jax
+
+    # same contract as maxbatch.py: the env var alone cannot override this
+    # image's TPU plugin, so CLSIM_PLATFORM=cpu must go through jax.config
+    platform = os.environ.get("CLSIM_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.models.workloads import scale_free
@@ -79,18 +93,20 @@ def main() -> None:
 
     cfg = SimConfig.for_workload(snapshots=args.snapshots, max_recorded=16,
                                  record_dtype="int16",
+                                 window_dtype=args.window_dtype,
                                  reduce_mode=args.reduce_mode,
-                                 split_markers=True)
+                                 split_markers=args.scheduler == "sync")
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
                            cfg, make_fast_delay(args.delay, 17),
-                           batch=args.batch, scheduler="sync")
+                           batch=args.batch, scheduler=args.scheduler)
     print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
-          f"mode={runner.kernel._mode}", file=sys.stderr)
+          f"scheduler={args.scheduler} mode={runner.kernel._mode}",
+          file=sys.stderr)
 
     # donation matches the production jits (TickKernel.tick / run_storm):
     # without it the profiled executable cannot alias state buffers and
     # runs in a different (2x-resident) HBM regime than the bench
-    tick = jax.jit(jax.vmap(runner.kernel._sync_tick), donate_argnums=0)
+    tick = jax.jit(jax.vmap(runner._tick_fn), donate_argnums=0)
     s = runner.init_batch_device()
     s = tick(s)
     jax.block_until_ready(s)
