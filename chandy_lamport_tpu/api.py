@@ -27,8 +27,13 @@ from chandy_lamport_tpu.utils.fixtures import (
 
 
 def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
-                 config: Optional[SimConfig] = None, trace: bool = False):
+                 config: Optional[SimConfig] = None, trace: bool = False,
+                 exact_impl: str = "cascade"):
     if name == "parity":
+        if exact_impl != "cascade":
+            raise ValueError(
+                "exact_impl is a jax-backend knob (the parity oracle has "
+                "one reference-literal implementation); use backend='jax'")
         from chandy_lamport_tpu.core.parity import ParitySim
 
         sim = ParitySim(delay_model,
@@ -47,15 +52,21 @@ def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
                 "hot loop (SURVEY.md §5); use backend='parity' for traces")
         from chandy_lamport_tpu.core.dense import DenseSim
 
-        return DenseSim(topology, delay_model, config or SimConfig())
+        return DenseSim(topology, delay_model, config or SimConfig(),
+                        exact_impl=exact_impl)
     raise ValueError(f"unknown backend {name!r} (expected 'parity' or 'jax')")
 
 
 def run_events(backend_name: str, topology: TopologySpec, events: List[Event],
                delay_model: DelayModel, config: Optional[SimConfig] = None,
-               trace: bool = False):
-    """Run a parsed event script to completion; returns (snapshots, sim)."""
-    sim = make_backend(backend_name, topology, delay_model, config, trace=trace)
+               trace: bool = False, exact_impl: str = "cascade"):
+    """Run a parsed event script to completion; returns (snapshots, sim).
+
+    ``exact_impl`` (jax backend only): "cascade" (default) or "fold" — the
+    two bit-identical formulations of the reference scheduler
+    (ops/tick.TickKernel docstring)."""
+    sim = make_backend(backend_name, topology, delay_model, config,
+                       trace=trace, exact_impl=exact_impl)
     if backend_name == "parity":
         from chandy_lamport_tpu.core.parity import run_events as _run
 
@@ -67,10 +78,12 @@ def run_events_file(top_path: str, events_path: str, backend: str = "parity",
                     seed: int = REFERENCE_TEST_SEED + 1,
                     delay_model: Optional[DelayModel] = None,
                     config: Optional[SimConfig] = None,
-                    trace: bool = False) -> Tuple[List[GlobalSnapshot], object]:
+                    trace: bool = False, exact_impl: str = "cascade",
+                    ) -> Tuple[List[GlobalSnapshot], object]:
     """Parse fixture files and run them — the ``runTest`` equivalent
     (snapshot_test.go:11-44) minus the assertions."""
     topology = read_topology_file(top_path)
     events = read_events_file(events_path)
     dm = delay_model if delay_model is not None else GoExactDelay(seed)
-    return run_events(backend, topology, events, dm, config, trace=trace)
+    return run_events(backend, topology, events, dm, config, trace=trace,
+                      exact_impl=exact_impl)

@@ -29,7 +29,8 @@ def _cmd_run(args) -> int:
 
     snaps, sim = run_events_file(args.topology, args.events,
                                  backend=args.backend, seed=args.seed,
-                                 trace=args.trace)
+                                 trace=args.trace,
+                                 exact_impl=args.exact_impl)
     for snap in snaps:
         print(snap.id)
         for nid in sorted(snap.token_map):
@@ -57,9 +58,10 @@ def _cmd_test(args) -> int:
     for top, events, snaps in REFERENCE_TESTS:
         name = events.removesuffix(".events")
         try:
-            actual, sim = run_events_file(fixture_path(top),
-                                          fixture_path(events),
-                                          backend=args.backend)
+            actual, sim = run_events_file(
+                fixture_path(top), fixture_path(events),
+                backend=args.backend,
+                exact_impl=getattr(args, "exact_impl", "cascade"))
             assert len(actual) == len(snaps), (
                 f"{len(actual)} snapshots, expected {len(snaps)}")
             check_tokens(sim.node_tokens(), actual)
@@ -153,10 +155,19 @@ def main(argv=None) -> int:
     pr.add_argument("--backend", choices=["parity", "jax"], default="parity")
     pr.add_argument("--seed", type=int, default=REFERENCE_TEST_SEED + 1)
     pr.add_argument("--trace", action="store_true")
+    pr.add_argument("--exact-impl", choices=["cascade", "fold"],
+                    default="cascade",
+                    help="jax backend: which bit-identical formulation of "
+                         "the reference scheduler runs the script "
+                         "(ops/tick.TickKernel docstring)")
     pr.set_defaults(fn=_cmd_run)
 
     pt = sub.add_parser("test", help="run the reference golden suite")
     pt.add_argument("--backend", choices=["parity", "jax"], default="parity")
+    pt.add_argument("--exact-impl", choices=["cascade", "fold"],
+                    default="cascade",
+                    help="jax backend: run the golden suite through this "
+                         "formulation of the reference scheduler")
     pt.set_defaults(fn=_cmd_test)
 
     ps = sub.add_parser("storm", help="batched scale run")
