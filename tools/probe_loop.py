@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Opportunistic TPU-tunnel watchdog (VERDICT r4 next-round item #1).
+
+The axon device tunnel has been down for two whole build rounds; the
+measured TPU rows (BASELINE.md) all predate round 4. This loop turns the
+single end-of-round bench lottery ticket into continuous sampling: every
+``--interval`` seconds it fires the bench's own liveness probe
+(``python -m chandy_lamport_tpu.bench --probe`` — jax.devices() + a tiny
+jit in a subprocess) under a short timeout, appends one JSON line per
+attempt to ``tools/probe_log.jsonl``, and the moment a probe answers
+``platform == "tpu"`` it runs the queued measurement plan
+(``tools/r4_measure.py``) exactly once, then keeps probing (a later
+window can still refresh rows with ``--rearm``).
+
+Designed to run unattended in tmux for the whole build round:
+
+    python tools/probe_loop.py --interval 900
+
+What it replaces at measurement time: the reference hot loop the rows
+time, /root/reference/chandy_lamport/sim.go:71-95.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "tools", "probe_log.jsonl")
+
+
+def now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+def append(row: dict) -> None:
+    row = {"ts": now(), **row}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row), flush=True)
+
+
+def probe(timeout: float) -> dict:
+    cmd = [sys.executable, "-m", "chandy_lamport_tpu.bench", "--probe"]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, cwd=ROOT,
+                              timeout=timeout)
+        dt = time.monotonic() - t0
+        lines = proc.stdout.decode().strip().splitlines()
+        if lines:
+            try:
+                row = json.loads(lines[-1])
+                return {"result": "ok", "elapsed_s": round(dt, 1), **row}
+            except json.JSONDecodeError:
+                pass
+        return {"result": "fail", "rc": proc.returncode,
+                "elapsed_s": round(dt, 1)}
+    except subprocess.TimeoutExpired:
+        return {"result": "hang", "elapsed_s": round(time.monotonic() - t0, 1)}
+
+
+def measure(timeout: float, only: str) -> int:
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "r4_measure.py")]
+    if only:
+        cmd += ["--only", only]
+    append({"event": "measure_start", "cmd": " ".join(cmd)})
+    rc = subprocess.call(cmd, cwd=ROOT, timeout=timeout)
+    append({"event": "measure_done", "rc": rc})
+    return rc
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=float, default=900.0,
+                   help="seconds between probe attempts (default 15 min)")
+    p.add_argument("--probe-timeout", type=float, default=120.0)
+    p.add_argument("--measure-timeout", type=float, default=4 * 3600.0,
+                   help="budget for one full r4_measure run")
+    p.add_argument("--only", default="",
+                   help="forwarded to r4_measure.py --only")
+    p.add_argument("--rearm", action="store_true",
+                   help="run the measurement plan again on a later live "
+                        "window instead of only once")
+    p.add_argument("--max-hours", type=float, default=13.0,
+                   help="stop probing after this many hours")
+    args = p.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    measured = False
+    attempt = 0
+    append({"event": "loop_start", "interval_s": args.interval})
+    while time.monotonic() < deadline:
+        attempt += 1
+        row = probe(args.probe_timeout)
+        append({"event": "probe", "attempt": attempt, **row})
+        if row.get("platform") == "tpu" and (args.rearm or not measured):
+            try:
+                measure(args.measure_timeout, args.only)
+            except subprocess.TimeoutExpired:
+                append({"event": "measure_timeout"})
+            measured = True
+        time.sleep(args.interval)
+    append({"event": "loop_end", "attempts": attempt, "measured": measured})
+
+
+if __name__ == "__main__":
+    main()
