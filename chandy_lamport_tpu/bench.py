@@ -130,6 +130,14 @@ def _parser() -> argparse.ArgumentParser:
                         "line (the every-tick window-counter writes); "
                         "default stays int32 until the TPU A/B "
                         "(tools/r4_measure.py step 6) confirms the win")
+    p.add_argument("--layouts", choices=["auto", "default"], default="auto",
+                   help="jit-boundary array layouts: 'auto' lets XLA keep "
+                        "its loop-preferred [B,S,E] layouts across the "
+                        "dispatch boundary (kills the {0,2,1}<->{0,1,2} "
+                        "transpose copies, 22%% of a bare round-3 tick — "
+                        "timed states are built directly in the compiled "
+                        "layouts); 'default' forces row-major boundaries "
+                        "(the round-3/4 behavior) for A/B")
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="fast-path delay sampler: the fused counter-hash "
                         "HashJaxDelay (default — same distribution as the "
@@ -306,7 +314,8 @@ def run_worker(args) -> int:
     runner = summary = None
     for cap_try in range(4):
         runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
-                               batch=args.batch, scheduler=args.scheduler)
+                               batch=args.batch, scheduler=args.scheduler,
+                               auto_layouts=args.layouts == "auto")
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -346,6 +355,10 @@ def run_worker(args) -> int:
             raise
         log(f"warmup (compile + run): {time.perf_counter() - t0:.1f}s")
         summary = BatchedRunner.summarize(final)
+        # with auto layouts, the warmup compile recorded the storm
+        # program's chosen state input formats — timed states are built
+        # directly in these, so every timed dispatch is boundary-copy-free
+        fmts = runner.storm_state_formats()
         # free the warmup state NOW: holding it across the timed loop's
         # fresh init doubles state residency and OOMs the large configs
         # (config 5: 9 GB resident -> 18 GB transient)
@@ -378,7 +391,7 @@ def run_worker(args) -> int:
     times, node_ticks = [], []
     mem = {}
     for r in range(args.repeats):
-        state = runner.init_batch_device()
+        state = runner.init_batch_device(formats=fmts)
         jax.block_until_ready(state)
         profiling = args.profile and r == args.repeats - 1
         if profiling:
@@ -422,6 +435,7 @@ def run_worker(args) -> int:
         "record_dtype": cfg.record_dtype,
         "max_recorded": cfg.max_recorded,
         "delay": args.delay,
+        "layouts": args.layouts,
     }
     result.update(mem)
     if dev.platform != "tpu":

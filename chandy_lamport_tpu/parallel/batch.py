@@ -46,6 +46,22 @@ from chandy_lamport_tpu.utils.fixtures import TopologySpec
 OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
 
 
+def _apply_formats(tree, formats):
+    """Relayout any leaf whose device format differs from the compiled
+    program's expectation (leaf-by-leaf, so the transient double residency
+    is one array, not the whole multi-GB state). States built by
+    ``init_batch_device(formats=...)`` match exactly — every leaf is a
+    no-op there."""
+    def place(x, f):
+        cur = getattr(x, "format", None)
+        if cur is not None and cur.layout == f.layout \
+                and cur.sharding == f.sharding:
+            return x
+        return jax.device_put(x, f)
+
+    return jax.tree_util.tree_map(place, tree, formats)
+
+
 class ScriptOps(NamedTuple):
     """A compiled event script: T phases of up to K ops, each phase followed
     by one tick iff its ``do_tick`` entry is set."""
@@ -112,7 +128,8 @@ class BatchedRunner:
 
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
-                 check_every: int = 0, exact_impl: str = "cascade"):
+                 check_every: int = 0, exact_impl: str = "cascade",
+                 auto_layouts: bool = False):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -126,7 +143,24 @@ class BatchedRunner:
         jitted storm run every K phases and once after drain, setting the
         sticky ERR_CONSERVATION bit on any lane where node balances +
         in-flight ring tokens drift from the initial total (SURVEY.md §5:
-        the jit-compatible sanitizer evaluated every K ticks)."""
+        the jit-compatible sanitizer evaluated every K ticks).
+
+        auto_layouts: let XLA choose parameter/result layouts for the
+        storm runs instead of forcing row-major at the jit boundary.
+        The TPU tick computes several ``[B, S, E]`` planes in a transposed
+        ({0,2,1}) layout; with default boundary layouts every dispatch
+        pays transpose copies on entry and exit (22% of a bare tick,
+        BASELINE.md round-3 profile). Mechanism (the JAX AOT layout
+        workflow — jit with ``Layout.AUTO`` rejects concrete arrays):
+        ``run_storm`` lowers with ShapeDtypeStructs, compiles once,
+        queries ``input_formats``, relayouts any mismatched input leaf,
+        and calls the compiled object directly; fresh timed states built
+        via ``init_batch_device(formats=storm_state_formats())`` are BORN
+        in the compiled layouts, so steady-state dispatches are
+        boundary-copy-free. Identity on CPU (XLA:CPU picks row-major).
+        Default OFF: the perf paths (bench --layouts auto,
+        tools/profile_tick.py) opt in; mesh-sharded states
+        (parallel/mesh.shard_batch) use the plain jits."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -156,6 +190,9 @@ class BatchedRunner:
         if check_every < 0:
             raise ValueError("check_every must be >= 0 (0 = off)")
         self.check_every = int(check_every)
+        self.auto_layouts = auto_layouts
+        self._storm_aot = {}   # (drain, prog shapes) -> AOT-compiled storm
+        self._storm_state_formats = None
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
         self._run_no_drain = jax.jit(
@@ -180,7 +217,17 @@ class BatchedRunner:
             single._replace(delay_state=()))
         return batched._replace(delay_state=self._batched_delay_state())
 
-    def init_batch_device(self) -> DenseState:
+    def storm_state_formats(self):
+        """The compiled storm program's state input Formats (layout +
+        sharding per leaf), available after the first ``run_storm`` under
+        ``auto_layouts``. Hand to ``init_batch_device(formats=...)`` so
+        fresh timed states enter the next dispatch with zero relayout
+        copies (VERDICT r4 #6: the {0,2,1}<->{0,1,2} boundary
+        transposes). None before the first auto run (or without
+        auto_layouts) — init then builds default-layout states."""
+        return self._storm_state_formats
+
+    def init_batch_device(self, formats=None) -> DenseState:
         """Fresh batched state constructed ON the device by a jitted builder
         — no host->device transfer of the (multi-GB) state.
 
@@ -191,7 +238,17 @@ class BatchedRunner:
         Everything in the initial state is zeros except the token balances
         (a [N] broadcast) and the per-lane PRNG keys, so XLA materializes it
         in microseconds.
+
+        ``formats``: optional pytree of device Formats (``state_formats``)
+        the builder emits directly — the state is born in the consuming
+        program's layouts, never relayouted (and never double-resident the
+        way a post-hoc device_put would transiently be).
         """
+        if getattr(self, "_init_device_formats", None) is not formats:
+            # formats changed (identity check): drop the cached builder
+            self._init_device_formats = formats
+            if hasattr(self, "_init_device"):
+                del self._init_device
         if not hasattr(self, "_init_device"):
             single = init_state(self.topo, self.config, None)
             template = single._replace(delay_state=())
@@ -211,7 +268,8 @@ class BatchedRunner:
                 return st._replace(delay_state=self._batched_delay_state())
 
             # cached: a fresh jit closure per call would retrace every time
-            self._init_device = jax.jit(build)
+            self._init_device = (jax.jit(build, out_shardings=formats)
+                                 if formats is not None else jax.jit(build))
         return self._init_device()
 
     def _batched_delay_state(self):
@@ -307,10 +365,41 @@ class BatchedRunner:
     def run_storm(self, state: DenseState, program,
                   drain: bool = True) -> DenseState:
         """Execute a StormProgram (bulk per-edge sends + scheduled snapshot
-        initiations + one tick per phase) over all lanes in one dispatch."""
+        initiations + one tick per phase) over all lanes in one dispatch.
+        Under ``auto_layouts``, dispatches the AOT-compiled executable with
+        XLA-chosen boundary layouts (constructor docstring)."""
         prog = tuple(jnp.asarray(x) for x in program)
-        fn = self._run_storm if drain else self._run_storm_no_drain
-        return fn(state, prog)
+        if not self.auto_layouts:
+            fn = self._run_storm if drain else self._run_storm_no_drain
+            return fn(state, prog)
+        comp = self._storm_compiled(state, prog, drain)
+        state_fmt, prog_fmt = comp.input_formats[0]
+        state = _apply_formats(state, state_fmt)
+        prog = _apply_formats(prog, prog_fmt)
+        return comp(state, prog)
+
+    def _storm_compiled(self, state, prog, drain: bool):
+        """AOT-compile the storm run with AUTO in/out layouts (cached per
+        program shape). Lowering takes abstract ShapeDtypeStructs — the
+        only arg form ``Layout.AUTO`` accepts — so this is the one compile
+        the run needs, not an extra one."""
+        key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
+        comp = self._storm_aot.get(key)
+        if comp is None:
+            from jax.experimental.layout import Format, Layout
+
+            fmt = Format(Layout.AUTO)
+            fn = jax.jit(
+                jax.vmap(self._run_storm_single if drain
+                         else self._run_storm_phases, in_axes=(0, None)),
+                donate_argnums=0, in_shardings=fmt, out_shardings=fmt)
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
+                (state, prog))
+            comp = fn.lower(*abstract).compile()
+            self._storm_aot[key] = comp
+            self._storm_state_formats = comp.input_formats[0][0]
+        return comp
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch
     #    axis these lower to XLA collectives over ICI) --------------------

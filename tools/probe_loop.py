@@ -85,26 +85,34 @@ def main() -> None:
     p.add_argument("--only", default="",
                    help="forwarded to r4_measure.py --only")
     p.add_argument("--rearm", action="store_true",
-                   help="run the measurement plan again on a later live "
-                        "window instead of only once")
+                   help="after a successful plan run, allow one re-run per "
+                        "LATER live window (i.e. after the tunnel went "
+                        "down and came back) instead of stopping at one")
     p.add_argument("--max-hours", type=float, default=13.0,
                    help="stop probing after this many hours")
     args = p.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600.0
     measured = False
+    was_live = False
     attempt = 0
     append({"event": "loop_start", "interval_s": args.interval})
     while time.monotonic() < deadline:
         attempt += 1
         row = probe(args.probe_timeout)
         append({"event": "probe", "attempt": attempt, **row})
-        if row.get("platform") == "tpu" and (args.rearm or not measured):
+        live = row.get("platform") == "tpu"
+        # fire on a down->up transition (or the first live probe); --rearm
+        # allows one re-run per LATER window, never back-to-back while the
+        # tunnel simply stays up. A timed-out/failed plan leaves the
+        # watchdog armed.
+        if live and not was_live and (args.rearm or not measured):
             try:
-                measure(args.measure_timeout, args.only)
+                rc = measure(args.measure_timeout, args.only)
+                measured = measured or rc == 0
             except subprocess.TimeoutExpired:
                 append({"event": "measure_timeout"})
-            measured = True
+        was_live = live
         time.sleep(args.interval)
     append({"event": "loop_end", "attempts": attempt, "measured": measured})
 

@@ -68,6 +68,14 @@ def main() -> None:
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     p.add_argument("--window-dtype", choices=["int32", "uint16"],
                    default="int32")
+    p.add_argument("--layouts", choices=["auto", "default"], default="auto",
+                   help="'auto' = XLA-chosen boundary layouts (same as "
+                        "bench --layouts auto; the repeated-tick dispatch "
+                        "reaches its layout fixed point after the warmup "
+                        "call, so the timed/traced ticks are free of the "
+                        "{0,2,1}<->{0,1,2} boundary copies — the in-scan "
+                        "regime); 'default' = row-major boundaries (the "
+                        "round-3 profile's 22%% copy lines) for A/B")
     p.add_argument("--snapshots", type=int, default=8)
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="same knob as bench --delay")
@@ -76,6 +84,7 @@ def main() -> None:
     args = p.parse_args()
 
     import jax
+    import numpy as np
 
     # same contract as maxbatch.py: the env var alone cannot override this
     # image's TPU plugin, so CLSIM_PLATFORM=cpu must go through jax.config
@@ -106,8 +115,29 @@ def main() -> None:
     # donation matches the production jits (TickKernel.tick / run_storm):
     # without it the profiled executable cannot alias state buffers and
     # runs in a different (2x-resident) HBM regime than the bench
-    tick = jax.jit(jax.vmap(runner._tick_fn), donate_argnums=0)
+    jit_kw = {"donate_argnums": 0}
+    if args.layouts == "auto":
+        from jax.experimental.layout import Format, Layout
+
+        jit_kw.update(in_shardings=Format(Layout.AUTO),
+                      out_shardings=Format(Layout.AUTO))
+    tick = jax.jit(jax.vmap(runner._tick_fn), **jit_kw)
     s = runner.init_batch_device()
+    s = tick(s)
+    # with auto layouts the output state carries the compiler-chosen
+    # formats; feeding it back reaches the copy-free fixed point, so the
+    # timed loop below measures the same regime as the storm scan's
+    # interior. Report what AUTO actually chose as evidence.
+    jax.block_until_ready(s)
+    if args.layouts == "auto":
+        nondefault = [
+            f"{np.shape(x)}:{x.format.layout.major_to_minor}"
+            for x in jax.tree_util.tree_leaves(s)
+            if hasattr(x, "format") and np.ndim(x) > 0
+            and x.format.layout.major_to_minor
+            != tuple(range(np.ndim(x)))]
+        print(f"auto layouts: {len(nondefault)} non-row-major state "
+              f"leaves {nondefault[:6]}", file=sys.stderr)
     s = tick(s)
     jax.block_until_ready(s)
 
