@@ -141,8 +141,12 @@ class SimConfig:
             derived = max(32, 4 * snapshots)
             if overrides.get("window_dtype") == "uint16":
                 # the modular window planes need L to be a power of two
-                # (an EXPLICIT non-power-of-two override still raises)
-                derived = 1 << (derived - 1).bit_length()
+                # (an EXPLICIT non-power-of-two override still raises);
+                # clamp at the mod-2^16 decode bound — past snapshots=8192
+                # the derivation would otherwise hand __post_init__ a value
+                # the caller never chose. A clamped L stays honest through
+                # ERR_RECORD_OVERFLOW at runtime.
+                derived = min(1 << (derived - 1).bit_length(), 32768)
             overrides["max_recorded"] = derived
         # an explicit queue_capacity override wins over the derived size
         capacity = overrides.pop("queue_capacity", (c + 7) // 8 * 8)

@@ -44,23 +44,30 @@ PRESETS = {
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--preset", choices=sorted(PRESETS), default=None,
-                   help="a BASELINE.md ladder config (overrides "
-                        "--graph/--nodes/--max-snapshots/--start)")
-    p.add_argument("--nodes", type=int, default=1024)
-    p.add_argument("--graph", choices=["sf", "ring", "er"], default="sf")
+                   help="a BASELINE.md ladder config (fills "
+                        "--graph/--nodes/--max-snapshots/--start; "
+                        "explicit flags win)")
+    # preset-controlled flags parse as None so an EXPLICIT value equal to
+    # the fallback is distinguishable from "not passed" (the old
+    # value == parser-default test silently let the preset override
+    # explicit flags); fallbacks are filled after the merge below
+    p.add_argument("--nodes", type=int, default=None)
+    p.add_argument("--graph", choices=["sf", "ring", "er"], default=None)
     p.add_argument("--attach", type=int, default=2)
-    p.add_argument("--start", type=int, default=256)
+    p.add_argument("--start", type=int, default=None)
     p.add_argument("--limit", type=int, default=1 << 22)
-    p.add_argument("--max-snapshots", type=int, default=8)
+    p.add_argument("--max-snapshots", type=int, default=None)
     p.add_argument("--record-dtype", choices=["int32", "int16"],
                    default="int32")
     args = p.parse_args()
-    if args.preset:
-        # presets fill flags the user left at their defaults; explicit
-        # flags (e.g. a custom --start) win over the preset
-        for k, v in PRESETS[args.preset].items():
-            if getattr(args, k) == p.get_default(k):
-                setattr(args, k, v)
+    preset = PRESETS[args.preset] if args.preset else {}
+    fallbacks = dict(nodes=1024, graph="sf", start=256, max_snapshots=8)
+    # a preset key outside the None-defaulted merge set would be silently
+    # dropped — fail loudly instead if one is ever added
+    assert set(preset) <= set(fallbacks), sorted(set(preset) - set(fallbacks))
+    for k, fallback in fallbacks.items():
+        if getattr(args, k) is None:
+            setattr(args, k, preset.get(k, fallback))
 
     platform = os.environ.get("CLSIM_PLATFORM")
     import jax

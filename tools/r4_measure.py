@@ -19,6 +19,10 @@ every row carries platform/device_kind, clamped shapes are labeled):
   6. window-dtype A/B at the headline config: uint16 window planes vs the
      int32 default (VERDICT r3 #7 — the [S, E] window-counter writes are
      the top profile line; flip the bench default if uint16 wins)
+  7. boundary-layout A/B at the headline config: --layouts default (the
+     round-3/4 row-major boundaries) vs step 1's row, which rides the
+     new --layouts auto default (VERDICT r4 #6 — the {0,2,1}<->{0,1,2}
+     jit-boundary transposes were 22% of a bare tick)
 
 Usage: python tools/r4_measure.py [--only 1,2,...] [--timeout S]
 Skips nothing silently: a failed row still appends its error JSON.
@@ -67,7 +71,7 @@ def main() -> None:
                    help="bench-internal full-size attempt budget")
     p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
     args = p.parse_args()
-    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 7))
+    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 8))
 
     def bench(name, extra):
         # outer budget: probe ladder + attempts; bench always prints a line
@@ -146,6 +150,13 @@ def main() -> None:
               ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
                "--phases", "32", "--snapshots", "8", "--scheduler", "sync",
                "--window-dtype", "uint16"])
+    if 7 in only:
+        # boundary-layout A/B: forced row-major boundaries vs step 1's
+        # --layouts auto default (VERDICT r4 #6)
+        bench("r4_config4_sf1k_sync_rowmajor",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "sync",
+               "--layouts", "default"])
     log("r4 measurement plan complete")
 
 
