@@ -435,7 +435,7 @@ def run_worker(args) -> int:
         "record_dtype": cfg.record_dtype,
         "max_recorded": cfg.max_recorded,
         "delay": args.delay,
-        "layouts": args.layouts,
+        "layouts": runner.layouts_effective,
     }
     result.update(mem)
     if dev.platform != "tpu":

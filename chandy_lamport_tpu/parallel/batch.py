@@ -191,6 +191,12 @@ class BatchedRunner:
             raise ValueError("check_every must be >= 0 (0 = off)")
         self.check_every = int(check_every)
         self.auto_layouts = auto_layouts
+        # set the first time the AOT path's executable rejects our layouts
+        # (the axon PJRT plugin's ``input_formats`` can disagree with the
+        # executable's true parameter layouts for some programs); once
+        # tripped, every storm run rides the plain row-major jits and
+        # ``layouts_effective`` reports the degradation
+        self._auto_broken = False
         self._storm_aot = {}   # (drain, prog shapes) -> AOT-compiled storm
         self._storm_state_formats = None
         self._run = jax.jit(
@@ -216,6 +222,18 @@ class BatchedRunner:
             lambda x: np.broadcast_to(np.asarray(x), (self.batch,) + np.shape(x)).copy(),
             single._replace(delay_state=()))
         return batched._replace(delay_state=self._batched_delay_state())
+
+    @property
+    def layouts_effective(self) -> str:
+        """The boundary-layout mode runs are actually using: 'auto' while
+        the AOT path is live, 'default' when auto_layouts is off, and
+        'default(auto-rejected)' after the executable rejected the
+        ``input_formats``-derived layouts and the runner degraded to the
+        row-major jits (bench rows record this, so a fallback can never
+        masquerade as an auto-layout measurement)."""
+        if not self.auto_layouts:
+            return "default"
+        return "default(auto-rejected)" if self._auto_broken else "auto"
 
     def storm_state_formats(self):
         """The compiled storm program's state input Formats (layout +
@@ -369,14 +387,35 @@ class BatchedRunner:
         Under ``auto_layouts``, dispatches the AOT-compiled executable with
         XLA-chosen boundary layouts (constructor docstring)."""
         prog = tuple(jnp.asarray(x) for x in program)
-        if not self.auto_layouts:
+        if not self.auto_layouts or self._auto_broken:
             fn = self._run_storm if drain else self._run_storm_no_drain
             return fn(state, prog)
         comp = self._storm_compiled(state, prog, drain)
         state_fmt, prog_fmt = comp.input_formats[0]
         state = _apply_formats(state, state_fmt)
         prog = _apply_formats(prog, prog_fmt)
-        return comp(state, prog)
+        try:
+            return comp(state, prog)
+        except ValueError as exc:
+            if "layouts" not in str(exc):
+                raise
+            # the executable's true parameter layouts disagree with what
+            # ``input_formats`` reported (observed on the axon TPU tunnel:
+            # e.g. program[0] reported {1,0} but required {0,1}) — arrays
+            # relayouted to the reported formats are then rejected at call
+            # time, before execution, so the donated buffers are still
+            # alive. Degrade permanently to the row-major jit boundaries
+            # (the measured round-3 path) rather than fail the run.
+            import warnings
+
+            warnings.warn(
+                "auto-layout AOT call rejected its own input_formats; "
+                f"falling back to default boundary layouts: {exc}")
+            self._auto_broken = True
+            self._storm_state_formats = None
+            self._storm_aot.clear()  # dead executables; free their programs
+            fn = self._run_storm if drain else self._run_storm_no_drain
+            return fn(state, prog)
 
     def _storm_compiled(self, state, prog, drain: bool):
         """AOT-compile the storm run with AUTO in/out layouts (cached per

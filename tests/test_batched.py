@@ -146,6 +146,61 @@ def test_auto_layouts_matches_default():
         np.testing.assert_array_equal(np.asarray(leaf_d), np.asarray(leaf_a))
 
 
+def test_auto_layout_rejection_falls_back():
+    """If the AOT executable rejects the ``input_formats``-derived layouts
+    at call time (observed on the axon TPU tunnel, where ``input_formats``
+    can disagree with the executable's true parameter layouts), the runner
+    must degrade permanently to the row-major jit path, produce the same
+    bits, and report the degradation via ``layouts_effective``."""
+    from chandy_lamport_tpu.models.workloads import storm_program
+
+    topo_spec, _ = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
+
+    def make(auto):
+        r = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
+                          batch=4, scheduler="sync", auto_layouts=auto)
+        p = storm_program(r.topo, phases=6, amount=1,
+                          snapshot_phases=[(0, 0), (2, 4)])
+        return r, p
+
+    ref_runner, prog = make(False)
+    ref = jax.device_get(ref_runner.run_storm(ref_runner.init_batch_device(), prog))
+
+    runner, prog = make(True)
+    state = runner.init_batch_device()
+    progj = tuple(jnp.asarray(x) for x in prog)
+
+    class RejectingComp:
+        """Stands in for the compiled storm: formats that match the live
+        arrays (so _apply_formats no-ops) but a call-time layout error."""
+        input_formats = (jax.tree_util.tree_map(
+            lambda x: x.format, (state, progj)), {})
+
+        def __call__(self, *a):
+            raise ValueError(
+                "Computation was compiled for input layouts that disagree "
+                "with the layouts of arguments passed to it.")
+
+    key = (True, tuple((tuple(x.shape), str(x.dtype)) for x in progj))
+    runner._storm_aot[key] = RejectingComp()
+    # sentinel: the fallback must reset this (bench would otherwise build
+    # timed states in the rejected layouts) and drop the dead executable
+    runner._storm_state_formats = object()
+    assert runner.layouts_effective == "auto"
+    with pytest.warns(UserWarning, match="falling back"):
+        final = runner.run_storm(state, prog)
+    assert runner.layouts_effective == "default(auto-rejected)"
+    assert runner.storm_state_formats() is None
+    assert not runner._storm_aot
+    for leaf_r, leaf_f in zip(jax.tree_util.tree_leaves(ref),
+                              jax.tree_util.tree_leaves(jax.device_get(final))):
+        np.testing.assert_array_equal(np.asarray(leaf_r), np.asarray(leaf_f))
+    # subsequent runs skip the AOT path entirely (no second warning)
+    final2 = runner.run_storm(runner.init_batch_device(), prog)
+    assert runner.layouts_effective == "default(auto-rejected)"
+    jax.block_until_ready(final2)
+
+
 def test_sharded_run_matches_unsharded():
     assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
     topo_spec, events = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
