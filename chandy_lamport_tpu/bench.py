@@ -333,7 +333,12 @@ def run_worker(args) -> int:
         # bottleneck (~16 s per repeat, 30x the actual simulation time)
         t0 = time.perf_counter()
         try:
-            final = runner.run_storm(runner.init_batch_device(), prog)
+            # compile-from-shapes first: the warmup state is then BORN in
+            # the executable's chosen layouts — no relayout dispatch, no
+            # transient double residency at near-HBM-limit batches
+            fmts0 = runner.prepare_storm(prog)
+            final = runner.run_storm(runner.init_batch_device(formats=fmts0),
+                                     prog)
             jax.block_until_ready(final)
         except Exception as exc:
             # device OOM surfaces as RESOURCE_EXHAUSTED locally, but through

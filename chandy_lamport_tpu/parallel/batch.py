@@ -46,20 +46,25 @@ from chandy_lamport_tpu.utils.fixtures import TopologySpec
 OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
 
 
-def _apply_formats(tree, formats):
-    """Relayout any leaf whose device format differs from the compiled
-    program's expectation (leaf-by-leaf, so the transient double residency
-    is one array, not the whole multi-GB state). States built by
-    ``init_batch_device(formats=...)`` match exactly — every leaf is a
-    no-op there."""
-    def place(x, f):
+def _formats_match(tree, formats) -> bool:
+    """True iff every leaf's live device format already equals the compiled
+    program's expectation (states built by ``init_batch_device(formats=...)``
+    qualify) — then the relayout dispatch can be skipped entirely."""
+    xs = jax.tree_util.tree_leaves(tree)
+    # a DCE'd input's format is None (stages._input_layouts_flat) — keep it
+    # as a leaf so the two flattenings stay aligned; the executable never
+    # reads a DCE'd input, so None matches anything
+    fs = jax.tree_util.tree_leaves(formats, is_leaf=lambda v: v is None)
+    if len(xs) != len(fs):
+        return False
+    for x, f in zip(xs, fs):
+        if f is None:
+            continue
         cur = getattr(x, "format", None)
-        if cur is not None and cur.layout == f.layout \
-                and cur.sharding == f.sharding:
-            return x
-        return jax.device_put(x, f)
-
-    return jax.tree_util.tree_map(place, tree, formats)
+        if (cur is None or cur.layout != f.layout
+                or cur.sharding != f.sharding):
+            return False
+    return True
 
 
 class ScriptOps(NamedTuple):
@@ -197,7 +202,8 @@ class BatchedRunner:
         # tripped, every storm run rides the plain row-major jits and
         # ``layouts_effective`` reports the degradation
         self._auto_broken = False
-        self._storm_aot = {}   # (drain, prog shapes) -> AOT-compiled storm
+        self._storm_aot = {}   # (drain, prog shapes) -> (compiled, relayout)
+        self._storm_prog_placed = {}  # same key -> (host values, placed prog)
         self._storm_state_formats = None
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
@@ -268,6 +274,17 @@ class BatchedRunner:
             if hasattr(self, "_init_device"):
                 del self._init_device
         if not hasattr(self, "_init_device"):
+            build = self._state_builder()
+            # cached: a fresh jit closure per call would retrace every time
+            self._init_device = (jax.jit(build, out_shardings=formats)
+                                 if formats is not None else jax.jit(build))
+        return self._init_device()
+
+    def _state_builder(self):
+        """The fresh-batched-state constructor as a traceable zero-arg
+        function (shared by ``init_batch_device`` and ``prepare_storm``'s
+        ``eval_shape``)."""
+        if not hasattr(self, "_build_fn"):
             single = init_state(self.topo, self.config, None)
             template = single._replace(delay_state=())
             tokens0 = jnp.asarray(self.topo.tokens0)
@@ -285,10 +302,24 @@ class BatchedRunner:
                                            jnp.iinfo(jnp.int32).max))
                 return st._replace(delay_state=self._batched_delay_state())
 
-            # cached: a fresh jit closure per call would retrace every time
-            self._init_device = (jax.jit(build, out_shardings=formats)
-                                 if formats is not None else jax.jit(build))
-        return self._init_device()
+            self._build_fn = build
+        return self._build_fn
+
+    def prepare_storm(self, program, drain: bool = True):
+        """AOT-compile the storm program from shapes alone and return the
+        state input Formats (or None without ``auto_layouts``). Callers
+        that build states AFTER this — ``init_batch_device(formats=...)``
+        — get arrays born in the executable's layouts, so even the very
+        first ``run_storm`` dispatch skips the relayout step entirely and
+        the multi-GB state is never transiently double-resident (the
+        bench's warmup does this; near-HBM-limit shapes such as the
+        maxbatch probes depend on it)."""
+        if not self.auto_layouts or self._auto_broken:
+            return None
+        prog = tuple(jnp.asarray(x) for x in program)
+        abstract_state = jax.eval_shape(self._state_builder())
+        comp, _ = self._storm_compiled(abstract_state, prog, drain)
+        return comp.input_formats[0][0]
 
     def _batched_delay_state(self):
         return self.delay.init_batch_state(self.batch)
@@ -390,41 +421,66 @@ class BatchedRunner:
         if not self.auto_layouts or self._auto_broken:
             fn = self._run_storm if drain else self._run_storm_no_drain
             return fn(state, prog)
-        comp = self._storm_compiled(state, prog, drain)
-        state_fmt, prog_fmt = comp.input_formats[0]
-        state = _apply_formats(state, state_fmt)
-        prog = _apply_formats(prog, prog_fmt)
+        comp, relayout = self._storm_compiled(state, prog, drain)
+        # benches pass the same program values every timed repeat, but each
+        # ``jnp.asarray`` lands in the default layout — when the executable
+        # chose a non-default program layout that would force the relayout
+        # dispatch into every timed region. Reuse the placed copy by value
+        # (the tensors are tiny; the state is the thing we must not copy).
+        key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
+        cached = self._storm_prog_placed.get(key)
+        if cached is not None and all(
+                np.array_equal(a, np.asarray(b))
+                for a, b in zip(cached[0], prog)):
+            prog = cached[1]
+        if not _formats_match((state, prog), comp.input_formats[0]):
+            # Relayout through a COMPILED identity whose output formats are
+            # pinned to the storm executable's input formats. A plain
+            # ``jax.device_put(x, format)`` is not reliable here: the axon
+            # TPU backend was observed producing its shape-preferred layout
+            # instead of the requested one, after which the AOT call's
+            # layout check rejects the arrays. An executable's output
+            # layouts, by contrast, are enforced by XLA itself, and the
+            # call-time check compares against the same ``_xla_in_layouts``
+            # list ``input_formats`` is built from — so this dispatch
+            # satisfies it by construction. Donated + aliased: leaves whose
+            # layout already matches pass through without a copy, so the
+            # multi-GB state is never double-resident.
+            host_prog = tuple(np.asarray(x) for x in prog)
+            state, prog = relayout(state, prog)
+            self._storm_prog_placed[key] = (host_prog, prog)
         try:
             return comp(state, prog)
         except ValueError as exc:
             if "layouts" not in str(exc):
                 raise
-            # the executable's true parameter layouts disagree with what
-            # ``input_formats`` reported (observed on the axon TPU tunnel:
-            # e.g. program[0] reported {1,0} but required {0,1}) — arrays
-            # relayouted to the reported formats are then rejected at call
-            # time, before execution, so the donated buffers are still
-            # alive. Degrade permanently to the row-major jit boundaries
-            # (the measured round-3 path) rather than fail the run.
+            # still rejected: degrade permanently to the row-major jit
+            # boundaries (the measured round-3 path) rather than fail the
+            # run. The rejection fires before execution, so the donated
+            # buffers are still alive.
             import warnings
 
             warnings.warn(
-                "auto-layout AOT call rejected its own input_formats; "
-                f"falling back to default boundary layouts: {exc}")
+                "auto-layout AOT call rejected executable-produced "
+                f"layouts; falling back to default boundary layouts: {exc}")
             self._auto_broken = True
             self._storm_state_formats = None
             self._storm_aot.clear()  # dead executables; free their programs
+            self._storm_prog_placed.clear()
             fn = self._run_storm if drain else self._run_storm_no_drain
             return fn(state, prog)
 
     def _storm_compiled(self, state, prog, drain: bool):
         """AOT-compile the storm run with AUTO in/out layouts (cached per
-        program shape). Lowering takes abstract ShapeDtypeStructs — the
-        only arg form ``Layout.AUTO`` accepts — so this is the one compile
-        the run needs, not an extra one."""
+        program shape), plus a donated identity jit whose output formats
+        are pinned to the storm executable's chosen input formats (the
+        run_storm relayout step). Lowering takes abstract
+        ShapeDtypeStructs — the only arg form ``Layout.AUTO`` accepts —
+        so this is the one compile the run needs, not an extra one (the
+        identity is a trivial aliasing program)."""
         key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
-        comp = self._storm_aot.get(key)
-        if comp is None:
+        entry = self._storm_aot.get(key)
+        if entry is None:
             from jax.experimental.layout import Format, Layout
 
             fmt = Format(Layout.AUTO)
@@ -432,13 +488,21 @@ class BatchedRunner:
                 jax.vmap(self._run_storm_single if drain
                          else self._run_storm_phases, in_axes=(0, None)),
                 donate_argnums=0, in_shardings=fmt, out_shardings=fmt)
+            # x may be a live array OR already a ShapeDtypeStruct (the
+            # prepare_storm compile-from-shapes path)
             abstract = jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype),
+                lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
                 (state, prog))
             comp = fn.lower(*abstract).compile()
-            self._storm_aot[key] = comp
+            # donate the (multi-GB) state so matching leaves alias through
+            # copy-free; the program tensors are tiny, copying them keeps
+            # caller-held arrays valid
+            relayout = jax.jit(lambda s, p: (s, p), donate_argnums=0,
+                               out_shardings=comp.input_formats[0])
+            entry = (comp, relayout)
+            self._storm_aot[key] = entry
             self._storm_state_formats = comp.input_formats[0][0]
-        return comp
+        return entry
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch
     #    axis these lower to XLA collectives over ICI) --------------------

@@ -9,7 +9,7 @@ single end-of-round bench lottery ticket into continuous sampling: every
 jit in a subprocess) under a short timeout, appends one JSON line per
 attempt to ``tools/probe_log.jsonl``, and the moment a probe answers
 ``platform == "tpu"`` it runs the queued measurement plan
-(``tools/r4_measure.py``) exactly once, then keeps probing (a later
+(``tools/r5_measure.py``) exactly once, then keeps probing (a later
 window can still refresh rows with ``--rearm``).
 
 Designed to run unattended in tmux for the whole build round:
@@ -66,7 +66,7 @@ def probe(timeout: float) -> dict:
 
 
 def measure(timeout: float, only: str) -> int:
-    cmd = [sys.executable, os.path.join(ROOT, "tools", "r4_measure.py")]
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "r5_measure.py")]
     if only:
         cmd += ["--only", only]
     append({"event": "measure_start", "cmd": " ".join(cmd)})
@@ -81,9 +81,9 @@ def main() -> None:
                    help="seconds between probe attempts (default 15 min)")
     p.add_argument("--probe-timeout", type=float, default=120.0)
     p.add_argument("--measure-timeout", type=float, default=4 * 3600.0,
-                   help="budget for one full r4_measure run")
+                   help="budget for one full r5_measure run")
     p.add_argument("--only", default="",
-                   help="forwarded to r4_measure.py --only")
+                   help="forwarded to r5_measure.py --only")
     p.add_argument("--rearm", action="store_true",
                    help="after a successful plan run, allow one re-run per "
                         "LATER live window (i.e. after the tunnel went "

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""The round-5 TPU measurement plan, one command.
+
+Round 5 finally caught a live tunnel window (2026-07-30 ~20:56-21:04 UTC)
+and banked five sync rows — headline config-4 at 120.5M, the 1M-instance
+north star at 256.7M (25.7x target) — before the tunnel wedged mid-plan.
+Three rows died on the auto-layout ``input_formats`` bug (fixed since:
+parallel/batch.py falls back to row-major boundaries when the executable
+rejects the reported layouts) and the rest never ran.  This plan records
+everything still missing, ordered by value-per-tunnel-second in case the
+next window is short:
+
+  1. on-device golden conformance of the cascade-exact scheduler
+     (VERDICT r4 #2): the 7 test_data/ goldens bit-exact through the jax
+     backend ON the TPU.  Semantics carried:
+     /root/reference/chandy_lamport/node.go:149-185, sim.go:76-92.
+  2. boundary-layout A/B at the headline config (VERDICT r4 #6):
+     --layouts default vs the auto row already banked.
+  3. uint16 window-plane A/B at the headline config (VERDICT r4 #5).
+  4. cascade exact at the full sync batches, configs 4 and 5 — the
+     N=8192 shape that faulted the round-3 device must run clean
+     (VERDICT r4 #2).
+  5. the one sync ladder row the wedge ate: config-2 ring-10 B=131072.
+  6. "exact semantics >= 10M" rows (VERDICT r4 #3): ER-256 first; the
+     ring-10 B=131k row LAST with a short timeout — its warmup is what
+     wedged the tunnel on 2026-07-30, so it must never again block the
+     rows ahead of it.
+  7. graphshard formulation tax on real ICI (VERDICT r4 weak #5).
+  8. maxbatch presets with the HBM axis (VERDICT r4 #8).
+
+Usage: python tools/r5_measure.py [--only 1,2,...] [--timeout S]
+Every row (including failures) appends to BASELINE_MEASURED.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_tool(name: str, script: str, extra: list, timeout: float, out: str,
+             argv0: list = None, env: dict = None,
+             parse=None) -> dict:
+    """Run one plan step and append its row. ``parse`` maps a finished
+    process to a row dict (default: the last stdout line as JSON)."""
+    cmd = (argv0 or [sys.executable, os.path.join(ROOT, script)]) + extra
+    log(f"--- {name}: {' '.join(cmd)}")
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT if parse else None,
+                              cwd=ROOT, timeout=timeout, env=env)
+        if parse:
+            row = parse(proc)
+        else:
+            lines = proc.stdout.decode().strip().splitlines()
+            row = (json.loads(lines[-1]) if lines
+                   else {"error": "no output", "rc": proc.returncode})
+    except subprocess.TimeoutExpired:
+        row = {"error": f"timed out after {timeout:.0f}s"}
+    except Exception as exc:  # a malformed row must not kill the plan
+        row = {"error": f"{type(exc).__name__}: {exc}"}
+    row["config"] = name
+    print(json.dumps(row), flush=True)
+    with open(out, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def conformance(timeout: float, out: str) -> dict:
+    """Run the 7-golden CLI conformance suite on the live device (the CLI
+    refuses bit-exact mode without x64) and append a pass/fail row."""
+    def parse(proc):
+        return {"metric": "golden_conformance_on_device",
+                "ok": proc.returncode == 0, "rc": proc.returncode,
+                "unit": "7 test_data goldens, bit-exact, cascade default",
+                "tail": proc.stdout.decode().strip().splitlines()[-8:]}
+
+    return run_tool(
+        "r5_conformance_tpu", "", [], timeout, out,
+        argv0=[sys.executable, "-m", "chandy_lamport_tpu", "test",
+               "--backend", "jax"],
+        env=dict(os.environ, JAX_ENABLE_X64="1"), parse=parse)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default="",
+                   help="comma-separated step numbers (default: all)")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="bench-internal full-size attempt budget")
+    p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
+    args = p.parse_args()
+    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 9))
+
+    def bench(name, extra, timeout=None):
+        t = timeout or args.timeout
+        return run_tool(name, "bench.py", extra + ["--timeout", str(t)],
+                        t * 3 + 600, args.out)
+
+    HEADLINE = ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
+                "--phases", "32", "--snapshots", "8", "--scheduler", "sync"]
+
+    if 1 in only:
+        conformance(1800.0, args.out)
+    if 2 in only:
+        bench("r5_config4_sf1k_sync_rowmajor", HEADLINE + ["--layouts", "default"])
+    if 3 in only:
+        bench("r5_config4_sf1k_sync_win16", HEADLINE + ["--window-dtype", "uint16"])
+    if 4 in only:
+        bench("r5_config4_sf1k_exact",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "exact"])
+        bench("r5_config5_sf8k_exact",
+              ["--graph", "sf", "--nodes", "8192", "--batch", "512",
+               "--phases", "16", "--snapshots", "8", "--scheduler", "exact"])
+    if 5 in only:
+        bench("r5_config2_ring10_sync",
+              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
+               "--phases", "32", "--snapshots", "1", "--scheduler", "sync"])
+    if 6 in only:
+        bench("r5_exact_at_scale_er256",
+              ["--graph", "er", "--nodes", "256", "--batch", "4096",
+               "--phases", "32", "--snapshots", "4",
+               "--scheduler", "exact", "--delay", "hash"])
+        # the tunnel-wedging row: short timeout, never ahead of others
+        bench("r5_exact_at_scale_ring10",
+              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
+               "--phases", "32", "--snapshots", "1",
+               "--scheduler", "exact", "--delay", "hash"], timeout=420.0)
+    if 7 in only:
+        bench("r5_gshard_base_sf1k_b1",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "1",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "sync"])
+        bench("r5_gshard_1shard_sf1k",
+              ["--graph", "sf", "--nodes", "1024", "--graphshard", "1",
+               "--phases", "32", "--snapshots", "8"])
+    if 8 in only:
+        for preset in ("northstar", "config3", "config4"):
+            run_tool(f"r5_maxbatch_{preset}", "tools/maxbatch.py",
+                     ["--preset", preset, "--record-dtype", "int16"],
+                     3600.0, args.out)
+    log("r5 measurement plan complete")
+
+
+if __name__ == "__main__":
+    main()
