@@ -74,6 +74,13 @@ def _cmd_test(args) -> int:
             failures += 1
             print(f"FAIL {name}: {exc}")
     print(f"{len(REFERENCE_TESTS) - failures}/{len(REFERENCE_TESTS)} passed")
+    if args.backend == "jax":
+        # which device actually ran the goldens — an on-device conformance
+        # claim (tools/r5_measure.py) must be checkable from this output
+        import jax
+
+        dev = jax.devices()[0]
+        print(f"platform: {dev.platform} ({dev.device_kind})")
     return 1 if failures else 0
 
 

@@ -5,10 +5,10 @@ Round 5 finally caught a live tunnel window (2026-07-30 ~20:56-21:04 UTC)
 and banked five sync rows — headline config-4 at 120.5M, the 1M-instance
 north star at 256.7M (25.7x target) — before the tunnel wedged mid-plan.
 Three rows died on the auto-layout ``input_formats`` bug (fixed since:
-parallel/batch.py falls back to row-major boundaries when the executable
-rejects the reported layouts) and the rest never ran.  This plan records
-everything still missing, ordered by value-per-tunnel-second in case the
-next window is short:
+parallel/batch.py relayouts through compiled identities and falls back to
+row-major boundaries on rejection) and the rest never ran.  This plan
+records everything still missing, ordered by value-per-tunnel-second in
+case the next window is short:
 
   1. on-device golden conformance of the cascade-exact scheduler
      (VERDICT r4 #2): the 7 test_data/ goldens bit-exact through the jax
@@ -21,12 +21,19 @@ next window is short:
      N=8192 shape that faulted the round-3 device must run clean
      (VERDICT r4 #2).
   5. the one sync ladder row the wedge ate: config-2 ring-10 B=131072.
-  6. "exact semantics >= 10M" rows (VERDICT r4 #3): ER-256 first; the
-     ring-10 B=131k row LAST with a short timeout — its warmup is what
-     wedged the tunnel on 2026-07-30, so it must never again block the
-     rows ahead of it.
+  6. "exact semantics >= 10M" at scale, ER-256 half (VERDICT r4 #3).
   7. graphshard formulation tax on real ICI (VERDICT r4 weak #5).
   8. maxbatch presets with the HBM axis (VERDICT r4 #8).
+  9. the ring-10 B=131k half of the "exact >= 10M" pair — dead LAST
+     with a short timeout: its warmup is what wedged the tunnel on
+     2026-07-30, so a repeat wedge must never cost any other row.
+
+The plan is resumable: a step whose full-shape on-device row is already
+in ``--out`` is skipped on re-fire (probe_loop --rearm), and when a row
+comes back non-TPU the plan re-probes the tunnel — if the tunnel is gone
+it stops immediately (exit 3) instead of burning the remaining rows'
+fallback ladders against a wedged device; a deterministic single-row
+failure with the tunnel alive does NOT stop the plan.
 
 Usage: python tools/r5_measure.py [--only 1,2,...] [--timeout S]
 Every row (including failures) appends to BASELINE_MEASURED.jsonl.
@@ -75,14 +82,36 @@ def run_tool(name: str, script: str, extra: list, timeout: float, out: str,
     return row
 
 
+def tunnel_alive(timeout: float = 120.0) -> bool:
+    """The bench's own liveness probe, used to distinguish 'this row fails
+    deterministically' from 'the tunnel died under the plan'."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "chandy_lamport_tpu.bench", "--probe"],
+            stdout=subprocess.PIPE, cwd=ROOT, timeout=timeout)
+        lines = proc.stdout.decode().strip().splitlines()
+        return bool(lines) and \
+            json.loads(lines[-1]).get("platform") == "tpu"
+    except Exception:
+        return False
+
+
 def conformance(timeout: float, out: str) -> dict:
     """Run the 7-golden CLI conformance suite on the live device (the CLI
-    refuses bit-exact mode without x64) and append a pass/fail row."""
+    refuses bit-exact mode without x64) and append a pass/fail row. The
+    CLI prints the executing platform after the verdict; it is parsed
+    into the row so a CPU run can never bank the on-device claim."""
     def parse(proc):
+        tail = proc.stdout.decode().strip().splitlines()[-9:]
+        platform = ""
+        for line in tail:
+            if line.startswith("platform: "):
+                platform = line.split()[1]
         return {"metric": "golden_conformance_on_device",
                 "ok": proc.returncode == 0, "rc": proc.returncode,
+                "platform": platform,
                 "unit": "7 test_data goldens, bit-exact, cascade default",
-                "tail": proc.stdout.decode().strip().splitlines()[-8:]}
+                "tail": tail}
 
     return run_tool(
         "r5_conformance_tpu", "", [], timeout, out,
@@ -98,56 +127,118 @@ def main() -> None:
     p.add_argument("--timeout", type=float, default=900.0,
                    help="bench-internal full-size attempt budget")
     p.add_argument("--out", default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
+    p.add_argument("--no-resume", action="store_true",
+                   help="re-run steps even if a banked TPU row exists")
     args = p.parse_args()
-    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 9))
+    only = {int(x) for x in args.only.split(",") if x} or set(range(1, 10))
 
-    def bench(name, extra, timeout=None):
+    def banked(name: str, full: dict = None) -> bool:
+        """A successful on-device row for this step already exists — skip
+        it, so a plan re-fired after a mid-window wedge (probe_loop
+        --rearm) spends the new window only on what's still missing.
+        ``full`` pins asked-shape fields (e.g. batch): a clamped
+        'tpu-small' fallback row must NOT bank the full-size step."""
+        if args.no_resume or not os.path.exists(args.out):
+            return False
+        for line in open(args.out):
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("config") != name:
+                continue
+            if not (row.get("platform") == "tpu"
+                    and (row.get("ok") is not False)):
+                continue
+            if full and any(row.get(k) != v for k, v in full.items()):
+                continue
+            log(f"--- {name}: banked on-device row exists, skipping")
+            return True
+        return False
+
+    aborted = []
+
+    def record(name, row):
+        """Shared tunnel-loss detector: on any non-TPU outcome, re-probe.
+        Tunnel gone -> stop the plan (the watchdog re-fires it, resume
+        skips banked rows). Tunnel alive -> the failure is row-specific;
+        keep going."""
+        if row and row.get("platform") != "tpu" and not tunnel_alive():
+            aborted.append(name)
+        return row
+
+    def bench(name, extra, timeout=None, full=None):
+        if banked(name, full):
+            return {}
+        if aborted:
+            log(f"--- {name}: tunnel lost earlier in the plan, leaving "
+                "queued for the next window")
+            return {}
         t = timeout or args.timeout
-        return run_tool(name, "bench.py", extra + ["--timeout", str(t)],
-                        t * 3 + 600, args.out)
+        return record(name, run_tool(
+            name, "bench.py", extra + ["--timeout", str(t)],
+            t * 3 + 600, args.out))
 
     HEADLINE = ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
                 "--phases", "32", "--snapshots", "8", "--scheduler", "sync"]
 
-    if 1 in only:
-        conformance(1800.0, args.out)
+    if 1 in only and not banked("r5_conformance_tpu") and not aborted:
+        record("r5_conformance_tpu", conformance(1800.0, args.out))
     if 2 in only:
-        bench("r5_config4_sf1k_sync_rowmajor", HEADLINE + ["--layouts", "default"])
+        bench("r5_config4_sf1k_sync_rowmajor",
+              HEADLINE + ["--layouts", "default"], full={"batch": 2048})
     if 3 in only:
-        bench("r5_config4_sf1k_sync_win16", HEADLINE + ["--window-dtype", "uint16"])
+        bench("r5_config4_sf1k_sync_win16",
+              HEADLINE + ["--window-dtype", "uint16"], full={"batch": 2048})
     if 4 in only:
         bench("r5_config4_sf1k_exact",
               ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
-               "--phases", "32", "--snapshots", "8", "--scheduler", "exact"])
+               "--phases", "32", "--snapshots", "8", "--scheduler", "exact"],
+              full={"batch": 2048})
         bench("r5_config5_sf8k_exact",
               ["--graph", "sf", "--nodes", "8192", "--batch", "512",
-               "--phases", "16", "--snapshots", "8", "--scheduler", "exact"])
+               "--phases", "16", "--snapshots", "8", "--scheduler", "exact"],
+              full={"batch": 512})
     if 5 in only:
         bench("r5_config2_ring10_sync",
               ["--graph", "ring", "--nodes", "10", "--batch", "131072",
-               "--phases", "32", "--snapshots", "1", "--scheduler", "sync"])
+               "--phases", "32", "--snapshots", "1", "--scheduler", "sync"],
+              full={"batch": 131072})
     if 6 in only:
         bench("r5_exact_at_scale_er256",
               ["--graph", "er", "--nodes", "256", "--batch", "4096",
                "--phases", "32", "--snapshots", "4",
-               "--scheduler", "exact", "--delay", "hash"])
-        # the tunnel-wedging row: short timeout, never ahead of others
-        bench("r5_exact_at_scale_ring10",
-              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
-               "--phases", "32", "--snapshots", "1",
-               "--scheduler", "exact", "--delay", "hash"], timeout=420.0)
+               "--scheduler", "exact", "--delay", "hash"],
+              full={"batch": 4096})
     if 7 in only:
         bench("r5_gshard_base_sf1k_b1",
               ["--graph", "sf", "--nodes", "1024", "--batch", "1",
-               "--phases", "32", "--snapshots", "8", "--scheduler", "sync"])
+               "--phases", "32", "--snapshots", "8", "--scheduler", "sync"],
+              full={"batch": 1})
         bench("r5_gshard_1shard_sf1k",
               ["--graph", "sf", "--nodes", "1024", "--graphshard", "1",
                "--phases", "32", "--snapshots", "8"])
     if 8 in only:
         for preset in ("northstar", "config3", "config4"):
-            run_tool(f"r5_maxbatch_{preset}", "tools/maxbatch.py",
-                     ["--preset", preset, "--record-dtype", "int16"],
-                     3600.0, args.out)
+            if banked(f"r5_maxbatch_{preset}") or aborted:
+                continue
+            record(f"r5_maxbatch_{preset}", run_tool(
+                f"r5_maxbatch_{preset}", "tools/maxbatch.py",
+                ["--preset", preset, "--record-dtype", "int16"],
+                3600.0, args.out))
+    if 9 in only:
+        # the tunnel-wedging row (its warmup hung the device for 900s on
+        # 2026-07-30): dead last with a short timeout, so a repeat wedge
+        # can no longer cost any other row
+        bench("r5_exact_at_scale_ring10",
+              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
+               "--phases", "32", "--snapshots", "1",
+               "--scheduler", "exact", "--delay", "hash"],
+              timeout=420.0, full={"batch": 131072})
+    if aborted:
+        log(f"plan aborted at '{aborted[0]}' (tunnel lost); re-fire to "
+            "resume the remaining rows")
+        sys.exit(3)
     log("r5 measurement plan complete")
 
 
