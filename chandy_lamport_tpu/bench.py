@@ -719,6 +719,13 @@ def main(argv=None) -> int:
         # full-size worker timeout plus the cpu fallback, not the
         # three-attempt TPU ladder
         plan.append(("default", env, [], args.timeout, None))
+        # a transient signal death (OOM-kill, segfault) with a live tunnel
+        # deserves one same-env full-size retry — cheap with the compile
+        # cache — exactly as the probed ladder classifies it; only a HANG
+        # means "wedged, fall straight to cpu"
+        plan.append(("default-retry", env, [],
+                     min(args.timeout, max(args.timeout / 2, 450.0)),
+                     "signal"))
         plan.append(("tpu-auto", {"CLSIM_PLATFORM": "auto"}, [],
                      min(args.timeout, 600.0), "crash"))
     elif platform == "tpu":
@@ -758,16 +765,20 @@ def main(argv=None) -> int:
 
     # gate per entry: None = always runs; "retryable" = only after a hang
     # or any crash-type failure (timeout, EXIT_BACKEND_INIT, signal
-    # death); "crash" = only after EXIT_BACKEND_INIT — the plugin-init
-    # failure a CLSIM_PLATFORM=auto rescue can actually fix. A hang or
-    # signal death is a tunnel wedge, where the rescue would hang
-    # identically.
-    prev_retryable = prev_backend_init = False
+    # death); "signal" = only after a signal death (transient OOM-kill /
+    # segfault — same-env retry is worthwhile, a hang is not, it means
+    # the tunnel wedged); "crash" = only after EXIT_BACKEND_INIT — the
+    # plugin-init failure a CLSIM_PLATFORM=auto rescue can actually fix.
+    prev_retryable = prev_backend_init = prev_signal = False
     for name, env_overrides, extra, timeout, gate in plan:
         if gate == "retryable" and not prev_retryable:
             # a clean rc!=0 failure is deterministic — a same-size retry
             # would fail identically
             log(f"skipping '{name}' (previous failure was not retryable)")
+            continue
+        if gate == "signal" and not prev_signal:
+            log(f"skipping '{name}' (previous failure was not a "
+                "signal death)")
             continue
         if gate == "crash" and not prev_backend_init:
             log(f"skipping '{name}' (previous failure was not a "
@@ -780,6 +791,7 @@ def main(argv=None) -> int:
             return 0
         prev_retryable = timed_out or retryable
         prev_backend_init = backend_init
+        prev_signal = retryable and not timed_out and not backend_init
         if not (timed_out or retryable):
             # a clean measurement failure (invalid results, repeated OOM) —
             # a smaller or different-platform attempt would only mask it

@@ -1,0 +1,143 @@
+"""Unit tests for the bench orchestrator's fallback-ladder gating.
+
+The ladder (chandy_lamport_tpu/bench.py main) is subprocess-driven in
+production; here ``_spawn`` is monkeypatched to script failure sequences
+so the gate semantics — which attempt runs after which failure kind —
+are pinned without any device or subprocess. These gates were
+hand-verified against a live wedged tunnel (round 5); the tests keep
+them from regressing silently.
+
+What the ladder models at measurement time: giving the reference hot
+loop's TPU measurement (/root/reference/chandy_lamport/sim.go:71-95)
+every realistic shot at the device before conceding a labeled fallback.
+"""
+
+import json
+
+import pytest
+
+from chandy_lamport_tpu import bench
+
+
+class ScriptedSpawn:
+    """Replaces bench._spawn: returns scripted outcomes per attempt name
+    and records the order of attempts."""
+
+    def __init__(self, outcomes):
+        # name -> (parsed|None, timed_out, retryable, backend_init)
+        self.outcomes = outcomes
+        self.calls = []
+
+    def __call__(self, name, mode, env_overrides, extra, timeout, argv):
+        self.calls.append(name)
+        if name not in self.outcomes:
+            pytest.fail(f"unscripted attempt {name!r} (ran {self.calls})")
+        return self.outcomes[name]
+
+
+OK = ({"metric": "node_ticks_per_sec_per_chip", "value": 1.0,
+       "platform": "tpu"}, False, False, False)
+HANG = (None, True, True, False)
+SIGNAL_DEATH = (None, False, True, False)      # rc in (-6, -9, -11)
+BACKEND_INIT = (None, False, True, True)       # clean EXIT_BACKEND_INIT
+CLEAN_FAIL = (None, False, False, False)       # deterministic rc=1
+
+
+def run_main(monkeypatch, capsys, argv, outcomes, platform="tpu"):
+    spawn = ScriptedSpawn(outcomes)
+    monkeypatch.setattr(bench, "_spawn", spawn)
+    monkeypatch.setattr(bench, "_find_live_platform",
+                        lambda args: (platform, {}))
+    rc = bench.main(argv)
+    assert rc == 0  # the orchestrator always exits 0 with one JSON line
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    return spawn.calls, json.loads(out[-1])
+
+
+def test_probed_path_retries_after_signal_death(monkeypatch, capsys):
+    # the round-5 review regression pin: a signal-killed full-size attempt
+    # (transient OOM-kill / segfault) must still get the full-size retry,
+    # exactly like a hang — not fall through to the clamped tpu-small row
+    calls, row = run_main(
+        monkeypatch, capsys, ["--timeout", "60"],
+        {"default": SIGNAL_DEATH, "default-retry": OK})
+    assert calls == ["default", "default-retry"]
+    assert row["platform"] == "tpu"
+
+
+def test_probed_path_no_retry_after_clean_failure(monkeypatch, capsys):
+    # deterministic rc=1 (invalid results, repeated OOM at final capacity):
+    # a same-size retry would fail identically and a clamped/CPU attempt
+    # would mask the failure with a success-shaped number
+    calls, row = run_main(
+        monkeypatch, capsys, ["--timeout", "60"], {"default": CLEAN_FAIL})
+    assert calls == ["default"]
+    assert row["platform"] == "none" and "error" in row
+
+
+def test_assume_tpu_success_is_single_attempt(monkeypatch, capsys):
+    calls, row = run_main(
+        monkeypatch, capsys, ["--assume-tpu", "--timeout", "60"],
+        {"default": OK})
+    assert calls == ["default"]
+    assert row["platform"] == "tpu"
+
+
+def test_assume_tpu_hang_skips_rescue_goes_cpu(monkeypatch, capsys):
+    # a hang means the tunnel wedged: the CLSIM_PLATFORM=auto rescue would
+    # hang identically, so the ladder must fall straight to the labeled
+    # cpu row (one worker timeout + fallback, as documented)
+    calls, row = run_main(
+        monkeypatch, capsys, ["--assume-tpu", "--timeout", "60"],
+        {"default": HANG,
+         "cpu": ({"metric": "node_ticks_per_sec_per_chip", "value": 1.0,
+                  "platform": "cpu"}, False, False, False)})
+    assert calls == ["default", "cpu"]
+    assert row["platform"] == "cpu"
+
+
+def test_assume_tpu_backend_init_fires_auto_rescue(monkeypatch, capsys):
+    # EXIT_BACKEND_INIT is the one failure CLSIM_PLATFORM=auto can fix
+    # (the round-1 plugin-init failure) — the rescue must fire there
+    calls, row = run_main(
+        monkeypatch, capsys, ["--assume-tpu", "--timeout", "60"],
+        {"default": BACKEND_INIT, "tpu-auto": OK})
+    assert calls == ["default", "tpu-auto"]
+    assert row["platform"] == "tpu"
+
+
+def test_assume_tpu_signal_death_gets_same_env_retry(monkeypatch, capsys):
+    # a transient signal death (OOM-kill / segfault) with a vouched-for
+    # tunnel gets one same-env full-size retry, cheap via the compile
+    # cache — matching the probed ladder's classification
+    calls, row = run_main(
+        monkeypatch, capsys, ["--assume-tpu", "--timeout", "60"],
+        {"default": SIGNAL_DEATH, "default-retry": OK})
+    assert calls == ["default", "default-retry"]
+    assert row["platform"] == "tpu"
+
+
+def test_assume_tpu_double_signal_death_goes_cpu(monkeypatch, capsys):
+    # two signal deaths in a row: not transient — skip the auto rescue
+    # (it is for plugin-init failures only) and bank the labeled cpu row
+    calls, row = run_main(
+        monkeypatch, capsys, ["--assume-tpu", "--timeout", "60"],
+        {"default": SIGNAL_DEATH, "default-retry": SIGNAL_DEATH,
+         "cpu": ({"metric": "node_ticks_per_sec_per_chip", "value": 1.0,
+                  "platform": "cpu"}, False, False, False)})
+    assert calls == ["default", "default-retry", "cpu"]
+    assert row["platform"] == "cpu"
+
+
+def test_dead_probe_path_tries_tpu_blind_then_cpu(monkeypatch, capsys):
+    # every probe hung: one blind full-size TPU attempt before the cpu
+    # fallback (the round-3 official number was lost to skipping this)
+    calls, row = run_main(
+        monkeypatch, capsys, ["--timeout", "60"],
+        {"tpu-blind": HANG,
+         "cpu": ({"metric": "node_ticks_per_sec_per_chip", "value": 1.0,
+                  "platform": "cpu"}, False, False, False)},
+        platform=None)
+    assert calls == ["tpu-blind", "cpu"]
+    assert row["platform"] == "cpu"
