@@ -200,6 +200,11 @@ def main() -> None:
     if 2 in only:
         bench("r5_config4_sf1k_sync_rowmajor",
               HEADLINE + ["--layouts", "default"], full={"batch": 2048})
+        # same-window auto-layout baseline: window-to-window spread on the
+        # shared tunnel was ±3-5% in rounds 3/5, so the A/B pairs compare
+        # against THIS window's auto row, not window 1's 120.5M
+        bench("r5_config4_sf1k_sync_auto",
+              HEADLINE, full={"batch": 2048})
     if 3 in only:
         bench("r5_config4_sf1k_sync_win16",
               HEADLINE + ["--window-dtype", "uint16"], full={"batch": 2048})
