@@ -64,8 +64,10 @@ def log(msg):
 def run_tool(name: str, script: str, extra: list, timeout: float, out: str,
              argv0: list = None, env: dict = None,
              parse=None) -> dict:
-    """Run one plan step and append its row. ``parse`` maps a finished
+    """Run one plan step and append its row (stamped with UTC time, so
+    cross-window pairs are distinguishable). ``parse`` maps a finished
     process to a row dict (default: the last stdout line as JSON)."""
+    import datetime
     cmd = (argv0 or [sys.executable, os.path.join(ROOT, script)]) + extra
     log(f"--- {name}: {' '.join(cmd)}")
     try:
@@ -83,6 +85,8 @@ def run_tool(name: str, script: str, extra: list, timeout: float, out: str,
     except Exception as exc:  # a malformed row must not kill the plan
         row = {"error": f"{type(exc).__name__}: {exc}"}
     row["config"] = name
+    row["ts"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
     print(json.dumps(row), flush=True)
     with open(out, "a") as f:
         f.write(json.dumps(row) + "\n")
@@ -174,8 +178,13 @@ def main() -> None:
             aborted.append(name)
         return row
 
-    def bench(name, extra, timeout=None, full=None):
-        if banked(name, full):
+    def bench(name, extra, timeout=None, full=None, rebank=False):
+        """``rebank``: always re-run even if banked — for the auto-layout
+        baseline, which must come from the SAME window as whatever A/B arm
+        runs in it (cross-window spread is the ±3-5% confound the row
+        exists to remove); with the persistent compile cache a re-run
+        costs ~a minute."""
+        if not rebank and banked(name, full):
             return {}
         if aborted:
             log(f"--- {name}: tunnel lost earlier in the plan, leaving "
@@ -202,9 +211,11 @@ def main() -> None:
               HEADLINE + ["--layouts", "default"], full={"batch": 2048})
         # same-window auto-layout baseline: window-to-window spread on the
         # shared tunnel was ±3-5% in rounds 3/5, so the A/B pairs compare
-        # against THIS window's auto row, not window 1's 120.5M
+        # against THIS window's auto row, not window 1's 120.5M. rebank:
+        # re-runs in every window that runs any A/B arm, so the pair is
+        # never split across windows (rows carry ts for pairing).
         bench("r5_config4_sf1k_sync_auto",
-              HEADLINE, full={"batch": 2048})
+              HEADLINE, full={"batch": 2048}, rebank=True)
     if 3 in only:
         bench("r5_config4_sf1k_sync_win16",
               HEADLINE + ["--window-dtype", "uint16"], full={"batch": 2048})

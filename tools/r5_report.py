@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Summarize the round-5 measured rows for BASELINE.md.
+
+Reads ``BASELINE_MEASURED.jsonl``, keeps the LAST row per r5_* config
+(the plan appends retries), and prints a markdown table plus A/B deltas
+(layouts, window dtype, exact-vs-sync) computed against the same-window
+auto baseline when it exists. Pure bookkeeping — the authoritative rows
+stay in the jsonl; what they measure is the reference hot loop,
+/root/reference/chandy_lamport/sim.go:71-95.
+
+Usage: python tools/r5_report.py [--jsonl PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# config -> short label for the table
+LABELS = {
+    "r5_conformance_tpu": "7/7 goldens bit-exact (cascade, x64)",
+    "r5_config4_sf1k_sync_rowmajor": "4: SF-1k sync, row-major layouts",
+    "r5_config4_sf1k_sync_auto": "4: SF-1k sync, auto layouts",
+    "r5_config4_sf1k_sync_win16": "4: SF-1k sync, uint16 windows",
+    "r5_exact_at_scale_er256": "3: ER-256 exact (hash delay)",
+    "r5_config4_sf1k_exact": "4: SF-1k exact",
+    "r5_config5_sf8k_exact_proof": "5: SF-8k exact proof (S=2, B=8)",
+    "r5_config5_sf8k_exact_full": "5: SF-8k exact, full shape",
+    "r5_config2_ring10_sync": "2: ring-10 sync B=131k",
+    "r5_exact_at_scale_ring10": "2: ring-10 exact B=131k",
+    "r5_gshard_base_sf1k_b1": "gshard baseline: SF-1k B=1 unsharded",
+    "r5_gshard_1shard_sf1k": "gshard: SF-1k 1-shard formulation",
+    "r5_maxbatch_northstar": "maxbatch: north-star ring-10",
+    "r5_maxbatch_config3": "maxbatch: config 3",
+    "r5_maxbatch_config4": "maxbatch: config 4",
+}
+
+
+def fmt(v):
+    return f"{v / 1e6:.1f}M" if isinstance(v, (int, float)) and v > 1e4 else v
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--jsonl",
+                   default=os.path.join(ROOT, "BASELINE_MEASURED.jsonl"))
+    args = p.parse_args()
+
+    rows = {}
+    for line in open(args.jsonl):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        cfg = row.get("config", "")
+        if cfg.startswith("r5_"):
+            rows[cfg] = row  # last wins
+
+    print("| Row | platform | value | unit | notes |")
+    print("|---|---|---|---|---|")
+    for cfg, label in LABELS.items():
+        row = rows.get(cfg)
+        if row is None:
+            print(f"| {label} | — | *not yet banked* | | |")
+            continue
+        plat = row.get("platform", "?")
+        val = row.get("value", row.get("ok"))
+        unit = row.get("unit", "")
+        notes = []
+        if row.get("ts"):
+            notes.append(row["ts"][5:16])  # MM-DDTHH:MM — window pairing
+        if row.get("error"):
+            notes.append(str(row["error"])[:60])
+        if row.get("vs_baseline") is not None:
+            notes.append(f"{row['vs_baseline']}x target")
+        if row.get("layouts"):
+            notes.append(f"layouts={row['layouts']}")
+        if row.get("batch") is not None:
+            notes.append(f"B={row['batch']}")
+        print(f"| {label} | {plat} | {fmt(val)} | {unit} | "
+              f"{'; '.join(notes)} |")
+    extra = sorted(set(rows) - set(LABELS))
+    for cfg in extra:
+        row = rows[cfg]
+        print(f"| {cfg} | {row.get('platform', '?')} | "
+              f"{fmt(row.get('value'))} | {row.get('unit', '')} | |")
+
+    base = rows.get("r5_config4_sf1k_sync_auto")
+    if base and base.get("platform") == "tpu":
+        b = base["value"]
+        print("\nA/B vs same-window auto baseline "
+              f"({fmt(b)} node-ticks/s):")
+        for cfg, tag in (("r5_config4_sf1k_sync_rowmajor", "row-major"),
+                         ("r5_config4_sf1k_sync_win16", "uint16 windows"),
+                         ("r5_config4_sf1k_exact", "exact scheduler")):
+            row = rows.get(cfg)
+            if row and row.get("platform") == "tpu":
+                d = (row["value"] - b) / b * 100
+                print(f"  {tag}: {fmt(row['value'])} ({d:+.1f}%)")
+
+
+if __name__ == "__main__":
+    main()
