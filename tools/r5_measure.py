@@ -14,18 +14,23 @@ case the next window is short:
      (VERDICT r4 #2): the 7 test_data/ goldens bit-exact through the jax
      backend ON the TPU.  Semantics carried:
      /root/reference/chandy_lamport/node.go:149-185, sim.go:76-92.
-  2. boundary-layout A/B at the headline config (VERDICT r4 #6):
-     --layouts default vs the auto row already banked.
-  3. uint16 window-plane A/B at the headline config (VERDICT r4 #5).
   6. "exact semantics >= 10M" at scale, ER-256 half (VERDICT r4 #3) —
-     promoted ahead of 4/5: it is the twice-carried verdict item and the
-     observed tunnel windows fit only ~2-5 rows.
+     promoted ahead of everything else: it is the twice-carried verdict
+     item and the observed tunnel windows fit only ~2-5 rows.
   4. cascade exact at config 4 full batch, plus a reduced N=8192 proof
      row — the shape that faulted the round-3 device must run clean
      (VERDICT r4 #2; the FULL config-5 exact shape costs ~196k
      sequential marker steps, longer than a whole tunnel window, and
      runs dead last in step 9 instead).
   5. the one sync ladder row the wedge ate: config-2 ring-10 B=131072.
+  2. boundary-layout A/B at the headline config (VERDICT r4 #6):
+     --layouts default vs auto. Banked same-window 2026-07-31 03:18Z:
+     119.97M row-major vs 120.99M auto (+0.9% auto).
+  3. uint16 window-plane A/B at the headline config (VERDICT r4 #5),
+     paired with a same-window auto baseline. Demoted behind the exact
+     rows 2026-07-31: its first on-device compile sat >840s and the
+     window died under it; a re-fire must not let it eat the next
+     window before the exact rows run.
   7. graphshard formulation tax on real ICI (VERDICT r4 weak #5).
   8. maxbatch presets with the HBM axis (VERDICT r4 #8).
   9. the two riskiest rows, after everything else: first the ring-10
@@ -206,22 +211,12 @@ def main() -> None:
 
     if 1 in only and not banked("r5_conformance_tpu") and not aborted:
         record("r5_conformance_tpu", conformance(1800.0, args.out))
-    if 2 in only:
-        bench("r5_config4_sf1k_sync_rowmajor",
-              HEADLINE + ["--layouts", "default"], full={"batch": 2048})
-        # same-window auto-layout baseline: window-to-window spread on the
-        # shared tunnel was ±3-5% in rounds 3/5, so the A/B pairs compare
-        # against THIS window's auto row, not window 1's 120.5M. rebank:
-        # re-runs in every window that runs any A/B arm, so the pair is
-        # never split across windows (rows carry ts for pairing).
-        bench("r5_config4_sf1k_sync_auto",
-              HEADLINE, full={"batch": 2048}, rebank=True)
-    if 3 in only:
-        bench("r5_config4_sf1k_sync_win16",
-              HEADLINE + ["--window-dtype", "uint16"], full={"batch": 2048})
-    # step 6 runs BEFORE 4 and 5: the "exact semantics >= 10M" row is the
-    # twice-carried VERDICT item (#3) and the observed windows fit ~2-5
-    # rows — value order, not numeric order
+    # step 6 runs FIRST among benches: the "exact semantics >= 10M" row is
+    # the twice-carried VERDICT item (#3) and the observed windows fit
+    # ~2-5 rows — value order, not numeric order. The uint16 A/B (step 3)
+    # moved BEHIND the exact rows on 2026-07-31: its fresh compile sat
+    # >840s and the window died under it, so on a re-fire it would retry
+    # first and risk eating every later window while the exact rows starve.
     if 6 in only:
         bench("r5_exact_at_scale_er256",
               ["--graph", "er", "--nodes", "256", "--batch", "4096",
@@ -254,6 +249,24 @@ def main() -> None:
               ["--graph", "ring", "--nodes", "10", "--batch", "131072",
                "--phases", "32", "--snapshots", "1", "--scheduler", "sync"],
               full={"batch": 131072})
+    if 2 in only:
+        bench("r5_config4_sf1k_sync_rowmajor",
+              HEADLINE + ["--layouts", "default"], full={"batch": 2048})
+    if 3 in only and not banked("r5_config4_sf1k_sync_win16",
+                                full={"batch": 2048}) and not aborted:
+        # same-window auto-layout baseline: window-to-window spread on the
+        # shared tunnel was ±3-5% in rounds 3/5, so the A/B pair compares
+        # against THIS window's auto row, not window 1's 120.5M. rebank:
+        # re-runs whenever the uint16 arm is still unbanked, so the pair
+        # is never split across windows (rows carry ts for pairing).
+        bench("r5_config4_sf1k_sync_auto",
+              HEADLINE, full={"batch": 2048}, rebank=True)
+        # 600s, not 900: its one observed on-device compile outlived the
+        # window (>840s); past ~10 min the window is dead anyway, and a
+        # shorter worker lets the plan detect tunnel loss sooner.
+        bench("r5_config4_sf1k_sync_win16",
+              HEADLINE + ["--window-dtype", "uint16"],
+              timeout=600.0, full={"batch": 2048})
     if 7 in only:
         bench("r5_gshard_base_sf1k_b1",
               ["--graph", "sf", "--nodes", "1024", "--batch", "1",
