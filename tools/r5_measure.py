@@ -14,9 +14,13 @@ case the next window is short:
      (VERDICT r4 #2): the 7 test_data/ goldens bit-exact through the jax
      backend ON the TPU.  Semantics carried:
      /root/reference/chandy_lamport/node.go:149-185, sim.go:76-92.
-  6. "exact semantics >= 10M" at scale, ER-256 half (VERDICT r4 #3) —
-     promoted ahead of everything else: it is the twice-carried verdict
-     item and the observed tunnel windows fit only ~2-5 rows.
+  6. "exact semantics >= 10M" at scale (VERDICT r4 #3) — promoted ahead
+     of everything else: it is the twice-carried verdict item and the
+     observed tunnel windows fit only ~2-5 rows. Ring-10 B=131k runs
+     first (its low marker density is what clears the 10M bar — a CPU
+     gauge put the marker-heavy ER-256 half at 13.9k/s at B=256; the
+     ring row's warmup wedged the 2026-07-30 window on pre-fix code, so
+     it gets a bounded 420s budget), then the ER-256 half.
   4. cascade exact at config 4 full batch, plus a reduced N=8192 proof
      row — the shape that faulted the round-3 device must run clean
      (VERDICT r4 #2; the FULL config-5 exact shape costs ~196k
@@ -33,12 +37,9 @@ case the next window is short:
      window before the exact rows run.
   7. graphshard formulation tax on real ICI (VERDICT r4 weak #5).
   8. maxbatch presets with the HBM axis (VERDICT r4 #8).
-  9. the two riskiest rows, after everything else: first the ring-10
-     B=131k half of the "exact >= 10M" pair (short timeout — its warmup
-     is what wedged the tunnel on 2026-07-30), then the full
-     ladder-shape config-5 exact row (~196k sequential marker steps,
-     likely longer than a whole window). A wedge here can only cost
-     the other step-9 row, nothing earlier.
+  9. the riskiest row dead last: the full ladder-shape config-5 exact
+     row (~196k sequential marker steps, likely longer than a whole
+     window). A wedge here costs nothing else.
 
 The plan is resumable: a step whose full-shape on-device row is already
 in ``--out`` is skipped on re-fire (probe_loop --rearm), and when a row
@@ -218,11 +219,24 @@ def main() -> None:
     # >840s and the window died under it, so on a re-fire it would retry
     # first and risk eating every later window while the exact rows starve.
     if 6 in only:
+        # ring-10 half FIRST (promoted from step 9 on 2026-07-31): a CPU
+        # gauge of the ER-256 half measured 13.9k node-ticks/s at B=256 —
+        # its marker density (4 snapshots x 763 edges -> ~40-80 cascade
+        # iterations per tick) makes it the slow row, while ring-10's one
+        # 10-edge snapshot leaves most ticks at zero iterations, so the
+        # ring half is the one that clears the >=10M bar. Short budget: if
+        # its warmup wedges the window again (it did once, 2026-07-30
+        # 21:04, on pre-input-formats-fix code) the loss is bounded.
+        bench("r5_exact_at_scale_ring10",
+              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
+               "--phases", "32", "--snapshots", "1",
+               "--scheduler", "exact", "--delay", "hash"],
+              timeout=420.0, full={"batch": 131072})
         bench("r5_exact_at_scale_er256",
               ["--graph", "er", "--nodes", "256", "--batch", "4096",
                "--phases", "32", "--snapshots", "4",
                "--scheduler", "exact", "--delay", "hash"],
-              full={"batch": 4096})
+              timeout=600.0, full={"batch": 4096})
     if 4 in only:
         # single repeat: an exact row's value is existence + magnitude, not
         # best-of-3, and the cascade's sequential cost (~S*E handle_marker
@@ -284,14 +298,6 @@ def main() -> None:
                 ["--preset", preset, "--record-dtype", "int16"],
                 3600.0, args.out))
     if 9 in only:
-        # the tunnel-wedging row (its warmup hung the device for 900s on
-        # 2026-07-30): dead last with a short timeout, so a repeat wedge
-        # can no longer cost any other row
-        bench("r5_exact_at_scale_ring10",
-              ["--graph", "ring", "--nodes", "10", "--batch", "131072",
-               "--phases", "32", "--snapshots", "1",
-               "--scheduler", "exact", "--delay", "hash"],
-              timeout=420.0, full={"batch": 131072})
         # the full ladder-shape config-5 exact row: ~196k sequential
         # marker steps (S=8 x E=24572) — likely longer than a whole
         # tunnel window, so it must never queue ahead of anything
