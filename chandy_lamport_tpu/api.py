@@ -62,9 +62,10 @@ def run_events(backend_name: str, topology: TopologySpec, events: List[Event],
                trace: bool = False, exact_impl: str = "cascade"):
     """Run a parsed event script to completion; returns (snapshots, sim).
 
-    ``exact_impl`` (jax backend only): "cascade" (default) or "fold" — the
-    two bit-identical formulations of the reference scheduler
-    (ops/tick.TickKernel docstring)."""
+    ``exact_impl`` (jax backend only): "cascade" (default), "wave", or
+    "fold" — the bit-identical formulations of the reference scheduler
+    (ops/tick.TickKernel docstring; "wave" requires a position-addressable
+    delay sampler such as FixedDelay's or HashJaxDelay's streams)."""
     sim = make_backend(backend_name, topology, delay_model, config,
                        trace=trace, exact_impl=exact_impl)
     if backend_name == "parity":

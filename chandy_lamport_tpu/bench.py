@@ -106,6 +106,13 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--phases", type=int, default=32, help="storm phases (ticks with traffic)")
     p.add_argument("--snapshots", type=int, default=8, help="concurrent initiators per instance")
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
+                   default="cascade",
+                   help="bit-exact tick formulation when --scheduler exact "
+                        "(ops/tick.TickKernel): 'wave' parallelizes same-"
+                        "tick markers across destinations — bit-identical "
+                        "for the hash/fixed samplers, fastest at marker-"
+                        "heavy shapes")
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync",
                    help="sync = vectorized simultaneous delivery (production "
                         "path); exact = reference-semantics sequential fold")
@@ -325,6 +332,7 @@ def run_worker(args) -> int:
     for cap_try in range(4):
         runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
                                batch=args.batch, scheduler=args.scheduler,
+                               exact_impl=args.exact_impl,
                                auto_layouts=args.layouts == "auto")
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
@@ -440,7 +448,8 @@ def run_worker(args) -> int:
         "vs_baseline": round(best / args.target, 3),
         "platform": dev.platform,
         "device_kind": dev.device_kind,
-        "scheduler": args.scheduler,
+        "scheduler": (args.scheduler if args.scheduler == "sync"
+                      else f"exact/{args.exact_impl}"),
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,

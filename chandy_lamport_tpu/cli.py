@@ -116,6 +116,7 @@ def _cmd_storm(args) -> int:
            if args.queue_capacity else {}))
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
+                           exact_impl=args.exact_impl,
                            check_every=args.check_every)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
@@ -162,19 +163,23 @@ def main(argv=None) -> int:
     pr.add_argument("--backend", choices=["parity", "jax"], default="parity")
     pr.add_argument("--seed", type=int, default=REFERENCE_TEST_SEED + 1)
     pr.add_argument("--trace", action="store_true")
-    pr.add_argument("--exact-impl", choices=["cascade", "fold"],
+    pr.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
                     default="cascade",
                     help="jax backend: which bit-identical formulation of "
                          "the reference scheduler runs the script "
-                         "(ops/tick.TickKernel docstring)")
+                         "(ops/tick.TickKernel docstring; 'wave' needs a "
+                         "position-addressable sampler, so it refuses the "
+                         "default Go-exact stream)")
     pr.set_defaults(fn=_cmd_run)
 
     pt = sub.add_parser("test", help="run the reference golden suite")
     pt.add_argument("--backend", choices=["parity", "jax"], default="parity")
-    pt.add_argument("--exact-impl", choices=["cascade", "fold"],
+    pt.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
                     default="cascade",
                     help="jax backend: run the golden suite through this "
-                         "formulation of the reference scheduler")
+                         "formulation of the reference scheduler (the "
+                         "goldens replay the Go-exact stream, which 'wave' "
+                         "refuses by design)")
     pt.set_defaults(fn=_cmd_test)
 
     ps = sub.add_parser("storm", help="batched scale run")
@@ -184,6 +189,11 @@ def main(argv=None) -> int:
     ps.add_argument("--phases", type=int, default=32)
     ps.add_argument("--snapshots", type=int, default=8)
     ps.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    ps.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
+                    default="cascade",
+                    help="--scheduler exact: the bit-exact tick formulation "
+                         "(ops/tick.TickKernel; 'wave' needs the hash/"
+                         "uniform-free samplers — i.e. --delay hash)")
     ps.add_argument("--seed", type=int, default=0)
     ps.add_argument("--queue-capacity", type=int, default=0,
                     help="per-edge ring slots; 0 = size to the workload "

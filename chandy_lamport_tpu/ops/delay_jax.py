@@ -45,11 +45,42 @@ class JaxDelay:
 
     max_delay: int
 
+    # True when a draw's VALUE depends only on its stream POSITION, not on
+    # the wall-clock order positions are consumed in (a pure function of
+    # (state, position)). The wave-exact tick (ops/tick._wave_tick) needs
+    # this: it precomputes every marker-broadcast draw's fold-order
+    # position at tick start and serves them out of order via
+    # block_receive_times, which is only stream-identical to sequential
+    # draw() calls for position-addressable samplers. False for the chained
+    # generators (GoExact's vendored stream, Uniform's split chain).
+    position_streams = False
+
     def init_state(self) -> Any:
         raise NotImplementedError
 
     def draw(self, dstate: Any, time: jnp.ndarray) -> Tuple[jnp.ndarray, Any]:
         raise NotImplementedError
+
+    def block_receive_times(self, dstate: Any, time,
+                            offsets: jnp.ndarray) -> jnp.ndarray:
+        """Receive times for draws at stream positions ``current + offsets``
+        (any shape, any order, duplicates allowed for masked-out elements)
+        WITHOUT advancing the stream — pair with ``advance_draws``. Only
+        meaningful when ``position_streams`` is True; bit-identical to
+        issuing the draws sequentially in offset order."""
+        raise NotImplementedError(
+            f"{type(self).__name__} draws are order-dependent; "
+            "block draws need a position-addressable sampler "
+            "(FixedJaxDelay, HashJaxDelay)")
+
+    def advance_draws(self, dstate: Any, count) -> Any:
+        """Advance the stream past ``count`` draws served (or about to be
+        served) by block_receive_times; bit-identical to the state after
+        ``count`` sequential draw() calls."""
+        raise NotImplementedError(
+            f"{type(self).__name__} draws are order-dependent; "
+            "block draws need a position-addressable sampler "
+            "(FixedJaxDelay, HashJaxDelay)")
 
     def draw_many(self, dstate: Any, time, shape) -> Tuple[jnp.ndarray, Any]:
         """receive times of the given shape (int or tuple) at once — the bulk
@@ -106,6 +137,8 @@ class GoExactJaxDelay(JaxDelay):
 
 
 class FixedJaxDelay(JaxDelay):
+    position_streams = True  # every position draws the same constant
+
     def __init__(self, delay: int = 1):
         if delay < 1:
             raise ValueError("delay must be >= 1")
@@ -117,6 +150,13 @@ class FixedJaxDelay(JaxDelay):
 
     def draw(self, dstate, time):
         return time + self.delay, dstate
+
+    def block_receive_times(self, dstate, time, offsets):
+        return jnp.broadcast_to(jnp.asarray(time + self.delay, jnp.int32),
+                                jnp.shape(offsets))
+
+    def advance_draws(self, dstate, count):
+        return dstate
 
 
 class UniformJaxDelay(JaxDelay):
@@ -192,6 +232,7 @@ class HashJaxDelay(JaxDelay):
     """
 
     _LANE_MULT = 0x85EBCA6B  # odd -> lane -> key is injective mod 2^32
+    position_streams = True  # value = hash(key, counter, epoch) only
 
     def __init__(self, seed: int, max_delay: int = MAX_DELAY):
         self.seed = seed
@@ -228,6 +269,19 @@ class HashJaxDelay(JaxDelay):
         new_ctr = ctr + jnp.uint32(n)
         return (time + 1 + self._delays(key, idx, elem_epoch),
                 (key, new_ctr, epoch + (new_ctr < ctr)))
+
+    def block_receive_times(self, dstate, time, offsets):
+        # same (epoch, counter) assignment as draw_many's wrap rule, so
+        # serving positions out of order cannot change any value
+        key, ctr, epoch = dstate
+        idx = ctr + jnp.asarray(offsets, jnp.uint32)
+        elem_epoch = epoch + (idx < ctr)
+        return time + 1 + self._delays(key, idx, elem_epoch)
+
+    def advance_draws(self, dstate, count):
+        key, ctr, epoch = dstate
+        new_ctr = ctr + jnp.asarray(count, jnp.uint32)
+        return (key, new_ctr, epoch + (new_ctr < ctr))
 
     def init_batch_state(self, batch):
         lane_key = self._base_key() ^ (

@@ -188,13 +188,29 @@ class TickKernel:
         exact_impl selects the bit-exact tick's formulation: "cascade"
         (default) vectorizes token deliveries and folds only over marker
         deliveries (_cascade_tick — O(E) + one sequential step per marker
-        delivered, instead of N scan steps per tick); "fold" is the
-        reference-literal N-step source scan (_tick), kept as the
-        specification form the cascade is differentially tested against."""
+        delivered, instead of N scan steps per tick); "wave" goes further
+        and processes every same-tick marker bound for a DISTINCT
+        destination in one vectorized step (_wave_tick — one sequential
+        step per marker-per-destination conflict; requires a
+        position-addressable delay sampler, JaxDelay.position_streams);
+        "fold" is the reference-literal N-step source scan (_tick), kept
+        as the specification form the others are differentially tested
+        against."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
-        if exact_impl not in ("cascade", "fold"):
+        if exact_impl not in ("cascade", "fold", "wave"):
             raise ValueError(f"unknown exact_impl {exact_impl!r}")
+        # only the ring (exact-scheduler) representation ever runs the
+        # exact tick; a split-mode kernel's sync path must not be refused
+        # over a formulation it will never execute
+        if (exact_impl == "wave" and marker_mode == "ring"
+                and not delay.position_streams):
+            raise ValueError(
+                "exact_impl='wave' precomputes the tick's delay draws at "
+                "their fold-order stream positions, which is only "
+                f"stream-identical for position-addressable samplers; "
+                f"{type(delay).__name__} draws are order-dependent — use "
+                "FixedJaxDelay or HashJaxDelay (or exact_impl='cascade')")
         self.marker_mode = marker_mode
         self.exact_impl = exact_impl
         self.topo = topo
@@ -244,6 +260,20 @@ class TickKernel:
             [[0], _np.cumsum(_np.bincount(topo.edge_src, minlength=n))])
         self._src_lo = jnp.asarray(src_bounds[:-1], _i32)
         self._src_hi = jnp.asarray(src_bounds[1:], _i32)
+        # wave-tick schedule constants (_wave_tick): the inverse of the
+        # by_dst permutation (scatter segment-scan results back to edge
+        # order), each by_dst position's segment start (per-destination
+        # exclusive counts from one global cumsum), each edge's ordinal
+        # among its source's outbound edges (edges are src-contiguous),
+        # and each edge's DESTINATION out-degree (broadcast draw counts)
+        self._inv_by_dst = jnp.asarray(_np.argsort(topo.by_dst,
+                                                   kind="stable"), _i32)
+        self._pos_seg_start = jnp.asarray(
+            topo.dst_bounds[:-1][topo.edge_dst[topo.by_dst]], _i32)
+        self._edge_ord_in_src = jnp.asarray(
+            _np.arange(e) - src_bounds[:-1][topo.edge_src], _i32)
+        outdeg = src_bounds[1:] - src_bounds[:-1]
+        self._outdeg_dst_e = jnp.asarray(outdeg[topo.edge_dst], _i32)
         self._mode = cfg.reduce_mode
         if self._mode == "auto":
             self._mode = "matmul" if n * e <= MATMUL_MAX_ELEMS else "segsum"
@@ -264,8 +294,9 @@ class TickKernel:
         # silently truncate (record_dtype shrinks the log_amt[L, E] HBM)
         self._rec_dtype = jnp.dtype(cfg.record_dtype)
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
-        self._exact_tick = (self._cascade_tick if exact_impl == "cascade"
-                            else self._tick)
+        self._exact_tick = {"cascade": self._cascade_tick,
+                            "wave": self._wave_tick,
+                            "fold": self._tick}[exact_impl]
         self.tick = jax.jit(self._exact_tick, donate_argnums=0)
         self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
@@ -491,6 +522,49 @@ class TickKernel:
         s, _ = lax.scan(per_source, s, jnp.arange(self.topo.n, dtype=_i32))
         return s
 
+    # ---- shared tick-start machinery for the vectorized exact forms -----
+
+    def _select_and_pop(self, s: DenseState):
+        """Tick-start delivery selection shared by the cascade and wave
+        formulations (fact 1 in _cascade_tick's docstring: selection is
+        invariant over the fold, so every selected head can be popped up
+        front with its payload captured). ``s.time`` must already be the
+        new tick's time. Returns (s, tok_pend, mk_pend, head_data)."""
+        C = self.cfg.queue_capacity
+        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
+        head_hit = cc == s.q_head[:, None]                        # [E, C]
+        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1,
+                          dtype=_i32)
+        head_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
+                            dtype=_i32)
+        head_mk = jnp.any(head_hit & s.q_marker, axis=-1)
+        elig = (s.q_len > 0) & (head_rt <= s.time)
+        # first eligible edge per source in dest order (same O(E) prefix-
+        # count formulation as _sync_tick; edges are per-source contiguous)
+        elig_i = elig.astype(_i32)
+        before = jnp.cumsum(elig_i) - elig_i
+        sel = elig & (before == before[self._src_first])
+        # pop every selected head now: selection is invariant (fact 1), and
+        # the captured head_data/head_mk carry the payloads
+        s = s._replace(q_head=(s.q_head + sel) % C,
+                       q_len=s.q_len - sel.astype(_i32))
+        return s, sel & ~head_mk, sel & head_mk, head_data
+
+    def _credit(self, s: DenseState, mask, amt_e) -> DenseState:
+        """HandleToken's balance half (node.go:175), vectorized: cheap
+        [E] -> [N] integer segment sums, applied eagerly per chunk so
+        _create_local freezes the right balances (node.go:77)."""
+        xs = jnp.take(jnp.where(mask, amt_e, 0), self._by_dst, axis=-1)
+        return s._replace(tokens=s.tokens + self._segment_sums(
+            xs, self._dst_lo, self._dst_hi))
+
+    def _seg_excl(self, x_d):
+        """Per-destination-segment EXCLUSIVE running sums of an [..., E]
+        operand already permuted into by_dst order: one global exclusive
+        cumsum rebased at each position's (static) segment start."""
+        cs0 = jnp.cumsum(x_d, axis=-1) - x_d
+        return cs0 - jnp.take(cs0, self._pos_seg_start, axis=-1)
+
     # ---- the cascade tick: bit-exact semantics without the N-step fold ---
 
     def _cascade_tick(self, s: DenseState) -> DenseState:
@@ -541,39 +615,14 @@ class TickKernel:
         faithful one at equal C; whenever neither impl flags, they are
         bit-identical. Size C with SimConfig.for_workload as always.
         """
-        C = self.cfg.queue_capacity
-        time = s.time + 1
-        s = s._replace(time=time)
-        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
-        head_hit = cc == s.q_head[:, None]                        # [E, C]
-        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1,
-                          dtype=_i32)
-        head_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
-                            dtype=_i32)
-        head_mk = jnp.any(head_hit & s.q_marker, axis=-1)
-        elig = (s.q_len > 0) & (head_rt <= time)
-        # first eligible edge per source in dest order (same O(E) prefix-
-        # count formulation as _sync_tick; edges are per-source contiguous)
-        elig_i = elig.astype(_i32)
-        before = jnp.cumsum(elig_i) - elig_i
-        sel = elig & (before == before[self._src_first])
-        # pop every selected head now: selection is invariant (fact 1), and
-        # captured head_data/head_mk carry the payloads
-        s = s._replace(q_head=(s.q_head + sel) % C,
-                       q_len=s.q_len - sel.astype(_i32))
-        tok_pend = sel & ~head_mk
-        mk_pend = sel & head_mk
+        s = s._replace(time=s.time + 1)
+        s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
         amt_e = jnp.where(tok_pend, head_data, 0)
         sid_e = head_data                       # marker payload: snapshot id
         rows = self._rows_e
 
         def credit(s, mask):
-            # HandleToken's balance half (node.go:175), vectorized: cheap
-            # [E] -> [N] integer segment sums, applied eagerly per chunk so
-            # _create_local freezes the right balances (node.go:77)
-            xs = jnp.take(jnp.where(mask, amt_e, 0), self._by_dst, axis=-1)
-            return s._replace(tokens=s.tokens + self._segment_sums(
-                xs, self._dst_lo, self._dst_hi))
+            return self._credit(s, mask, amt_e)
 
         # HandleToken's recording half is DEFERRED: each edge appends at
         # most once per tick (at a fixed log position), so the heavy [L, E]
@@ -603,6 +652,184 @@ class TickKernel:
             cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend)))
         s = credit(s, tok_pend)
         app = app | (tok_pend & jnp.any(s.recording, axis=-2))
+        log, cnt, err = log_append_masked(
+            s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
+            self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
+        return s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
+
+    # ---- the wave tick: the cascade with cross-destination parallelism --
+
+    def _wave_tick(self, s: DenseState) -> DenseState:
+        """Bit-identical to ``_cascade_tick`` for position-addressable delay
+        samplers (JaxDelay.position_streams), but each sequential step
+        processes EVERY pending marker bound for a distinct destination at
+        once — sequential steps per tick drop from "markers delivered" to
+        "max markers per single destination", the conflict depth.
+
+        Why cross-destination markers commute. handle_marker(e, sid) at
+        dst = dst(e) touches only per-(sid, dst) cells (has_local, frozen,
+        rem, done_local), per-inbound-edge-of-dst planes (recording,
+        rec_start/rec_end, min_prot — min is order-free), per-outbound-
+        edge-of-dst ring slots (the re-broadcast pushes), and commutative
+        accumulators (completed). Distinct destinations have disjoint
+        inbound/outbound edge sets and disjoint (sid, dst) cells, so the
+        ONLY cross-destination coupling in the fold is the delay sampler's
+        draw order — and for a sampler whose draw value depends only on
+        its stream position, every broadcast draw's fold-order position is
+        computable at tick start (whether a pending marker is a FIRST
+        receipt — the only kind that draws — depends on has_local plus
+        earlier same-(sid, dst) pending markers, both tick-start facts),
+        so waves can serve the draws out of order, bit-identically.
+
+        Token interleaving is per-destination too: a token on edge t only
+        couples to markers at dst(t) (its credit feeds frozen[.., dst(t)];
+        its append mask reads recording[:, t], which only markers at
+        dst(t) or on edge t itself can change). Each wave applies, per
+        destination, exactly the tokens whose fold rank precedes that
+        destination's current marker — the same prefix the cascade's
+        one-marker steps apply, reassociated across commuting credits.
+
+        Same-destination markers (the genuinely sequential interactions:
+        has_local/rem evolution, window closes, frozen balances between
+        two same-dst markers) stay ordered: wave k takes each
+        destination's k-th pending marker in edge (= fold) order.
+
+        Capacity semantics match the cascade exactly (heads popped up
+        front; the documented fold divergence at exactly-full C applies
+        unchanged). Reference semantics carried: node.go:149-185 (the
+        handlers), sim.go:76-92 (the fold this reassociates).
+        """
+        C = self.cfg.queue_capacity
+        S, E = self.cfg.max_snapshots, self.topo.e
+        s = s._replace(time=s.time + 1)
+        time = s.time
+        s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
+        amt_e = jnp.where(tok_pend, head_data, 0)
+        sid_e = head_data                       # marker payload: snapshot id
+        rank_e = self._rows_e                   # fold rank == edge index
+        onehot_se = jnp.arange(S, dtype=_i32)[:, None] == sid_e[None, :]
+
+        # ---- tick-start schedule: which pending markers are FIRST
+        # receipts (they alone draw delays and broadcast), and each one's
+        # fold-order draw-counter base
+        pend_se = onehot_se & mk_pend[None, :]                     # [S, E]
+        earlier_d = self._seg_excl(
+            jnp.take(pend_se.astype(_i32), self._by_dst, axis=-1))
+        earlier_se = jnp.take(earlier_d, self._inv_by_dst, axis=-1)
+        earlier_same = jnp.sum(jnp.where(pend_se, earlier_se, 0), axis=-2)
+        hl_e = jnp.any(onehot_se & jnp.take(s.has_local, self._edge_dst,
+                                            axis=-1), axis=-2)     # [E]
+        first_e = mk_pend & ~hl_e & (earlier_same == 0)
+        draws_e = jnp.where(first_e, self._outdeg_dst_e, 0)
+        base_e = jnp.cumsum(draws_e, axis=-1) - draws_e            # [E]
+        # the stream advances past the whole tick's draws up front; waves
+        # read their slices positionally from the frozen pre-tick state
+        dstate0 = s.delay_state
+        s = s._replace(delay_state=self.delay.advance_draws(
+            dstate0, jnp.sum(draws_e, axis=-1)))
+        cc = jnp.arange(C, dtype=_i32)[None, :]
+        sid_rows = jnp.arange(S, dtype=_i32)[:, None]              # [S, 1]
+
+        def dstv(wm, x_e):
+            """The (at most one) wave marker per destination's value of a
+            per-edge quantity -> [N] (0 where no marker). Always integer
+            segment sums: draw bases exceed the f32-exact matmul range."""
+            xs = jnp.take(jnp.where(wm, x_e, 0), self._by_dst, axis=-1)
+            return self._segment_sums(xs, self._dst_lo, self._dst_hi)
+
+        def cond(carry):
+            return jnp.any(carry[1])
+
+        def body(carry):
+            s, mk_rem, tok_rem, app = carry
+            # this wave: each destination's first remaining pending marker
+            head_d = self._seg_excl(
+                jnp.take(mk_rem.astype(_i32), self._by_dst, axis=-1))
+            wm = mk_rem & (jnp.take(head_d, self._inv_by_dst, axis=-1) == 0)
+            wdst = dstv(wm, jnp.ones_like(rank_e)) > 0             # [N]
+            wsid_n = dstv(wm, sid_e)                               # [N]
+            wexcl_n = dstv(wm, rank_e)      # the marker's own edge, per dst
+            wrank_n = jnp.where(wdst, wexcl_n, E)    # no marker -> +inf
+            wfirst_n = dstv(wm, first_e.astype(_i32)) > 0          # [N]
+            wbase_n = dstv(wm, base_e)                             # [N]
+            # tokens whose fold rank precedes their destination's marker
+            tmask = tok_rem & (rank_e < jnp.take(wrank_n, self._edge_dst,
+                                                 axis=-1))
+            s = self._credit(s, tmask, amt_e)
+            app = app | (tmask & jnp.any(s.recording, axis=-2))
+            tok_rem = tok_rem & ~tmask
+            # repeat markers: close their own channel's window (node.go:
+            # 160-164); rec_cnt[e] is live — a marker edge has no pending
+            # append this tick
+            rep_se = onehot_se & (wm & ~first_e)[None, :]          # [S, E]
+            rep_sn = self._segment_sums(
+                jnp.take(rep_se.astype(_i32), self._by_dst, axis=-1),
+                self._dst_lo, self._dst_hi)                        # [S, N]
+            first_sn = (sid_rows == wsid_n[None, :]) & wfirst_n[None, :]
+            # first markers: CreateLocalSnapshot excluding the marker's
+            # link (node.go:58-84), windows opened at the counter each edge
+            # will have once this tick's earlier-rank appends land
+            open_e = (jnp.take(wfirst_n, self._edge_dst, axis=-1)
+                      & (rank_e != jnp.take(wexcl_n, self._edge_dst,
+                                            axis=-1)))
+            open_se = ((sid_rows == jnp.take(wsid_n, self._edge_dst,
+                                             axis=-1)[None, :])
+                       & open_e[None, :])                          # [S, E]
+            cnt_open = s.rec_cnt + app.astype(_i32)
+            s = s._replace(
+                recording=(s.recording | open_se) & ~rep_se,
+                rec_end=jnp.where(
+                    rep_se, s.rec_cnt[None, :].astype(s.rec_end.dtype),
+                    s.rec_end),
+                rec_start=jnp.where(
+                    open_se, cnt_open[None, :].astype(s.rec_start.dtype),
+                    s.rec_start),
+                min_prot=jnp.where(open_e,
+                                   jnp.minimum(s.min_prot, cnt_open),
+                                   s.min_prot),
+                has_local=s.has_local | first_sn,
+                frozen=jnp.where(first_sn, s.tokens[None, :], s.frozen),
+                rem=jnp.where(first_sn,
+                              self._in_degree[None, :] - 1,
+                              s.rem - rep_sn),
+            )
+            # re-broadcast (node.go:97-109): one marker per outbound edge
+            # of each first-receipt destination, receive times served from
+            # the tick-start stream positions
+            push_g = jnp.take(wfirst_n, self._edge_src, axis=-1)   # [E]
+            sid_g = jnp.take(wsid_n, self._edge_src, axis=-1)
+            off_g = (jnp.take(wbase_n, self._edge_src, axis=-1)
+                     + self._edge_ord_in_src)
+            rt_g = self.delay.block_receive_times(dstate0, time, off_g)
+            pos_g = (s.q_head + s.q_len) % C
+            poh = (cc == pos_g[:, None]) & push_g[:, None]         # [E, C]
+            err = s.error | jnp.where(
+                jnp.any(push_g & (s.q_len >= C)),
+                ERR_QUEUE_OVERFLOW, 0).astype(_i32)
+            err = err | jnp.where(
+                jnp.any(push_g & (s.tok_pushed >= self._key_limit)),
+                ERR_VALUE_OVERFLOW, 0).astype(_i32)
+            s = s._replace(
+                q_data=jnp.where(poh, sid_g[:, None], s.q_data),
+                q_rtime=jnp.where(poh, jnp.asarray(rt_g, _i32)[:, None],
+                                  s.q_rtime),
+                q_marker=s.q_marker | poh,
+                q_len=s.q_len + push_g.astype(_i32),
+                tok_pushed=s.tok_pushed + push_g.astype(_i32),
+                error=err,
+            )
+            # finalize after every receipt (R8, node.go:165-170)
+            wm_sn = (sid_rows == wsid_n[None, :]) & wdst[None, :]  # [S, N]
+            fire = wm_sn & s.has_local & (s.rem == 0) & ~s.done_local
+            s = s._replace(
+                done_local=s.done_local | fire,
+                completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32))
+            return s, mk_rem & ~wm, tok_rem, app
+
+        s, _, tok_rem, app = lax.while_loop(
+            cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend)))
+        s = self._credit(s, tok_rem, amt_e)
+        app = app | (tok_rem & jnp.any(s.recording, axis=-2))
         log, cnt, err = log_append_masked(
             s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
             self._rec_dtype, self._rec_limit, self.cfg.max_recorded)

@@ -138,7 +138,9 @@ class BatchedRunner:
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
-        — while exact_impl='fold' is the reference-literal N-step source
+        — 'wave' parallelizes same-tick markers across destinations on top
+        of that, bit-identical for position-addressable samplers, and
+        exact_impl='fold' is the reference-literal N-step source
         scan kept as the specification form); 'sync' = simultaneous
         delivery (deterministic, protocol-equivalent, O(E) vectorized work
         per tick — the production/benchmark path, ops/tick._sync_tick).

@@ -20,7 +20,9 @@ case the next window is short:
      first (its low marker density is what clears the 10M bar — a CPU
      gauge put the marker-heavy ER-256 half at 13.9k/s at B=256; the
      ring row's warmup wedged the 2026-07-30 window on pre-fix code, so
-     it gets a bounded 420s budget), then the ER-256 half.
+     it gets a bounded 420s budget), then the ER-256 half as a
+     cascade/wave A/B pair (the wave-exact tick measured 15.4x the
+     cascade on the CPU gauge at this marker density, bit-identical).
   4. cascade exact at config 4 full batch, plus a reduced N=8192 proof
      row — the shape that faulted the round-3 device must run clean
      (VERDICT r4 #2; the FULL config-5 exact shape costs ~196k
@@ -237,6 +239,15 @@ def main() -> None:
                "--phases", "32", "--snapshots", "4",
                "--scheduler", "exact", "--delay", "hash"],
               timeout=600.0, full={"batch": 4096})
+        # the wave formulation's headline A/B (same shape as the cascade
+        # row above): 15.4x the cascade on a CPU gauge at this marker
+        # density (747.6 -> 48.5 ms/batched tick at B=64, bit-identical
+        # trajectories — tests/test_wave.py)
+        bench("r5_exact_at_scale_er256_wave",
+              ["--graph", "er", "--nodes", "256", "--batch", "4096",
+               "--phases", "32", "--snapshots", "4", "--scheduler", "exact",
+               "--exact-impl", "wave", "--delay", "hash"],
+              timeout=600.0, full={"batch": 4096})
     if 4 in only:
         # single repeat: an exact row's value is existence + magnitude, not
         # best-of-3, and the cascade's sequential cost (~S*E handle_marker
@@ -258,6 +269,13 @@ def main() -> None:
                "--phases", "8", "--snapshots", "2", "--scheduler", "exact",
                "--repeats", "1"],
               timeout=600.0, full={"batch": 8})
+        # config-4 exact through the wave: the cascade's ~S*E sequential
+        # marker steps collapse to per-destination conflict depth
+        bench("r5_config4_sf1k_exact_wave",
+              ["--graph", "sf", "--nodes", "1024", "--batch", "2048",
+               "--phases", "32", "--snapshots", "8", "--scheduler", "exact",
+               "--exact-impl", "wave", "--repeats", "1"],
+              timeout=600.0, full={"batch": 2048})
     if 5 in only:
         bench("r5_config2_ring10_sync",
               ["--graph", "ring", "--nodes", "10", "--batch", "131072",
@@ -298,8 +316,16 @@ def main() -> None:
                 ["--preset", preset, "--record-dtype", "int16"],
                 3600.0, args.out))
     if 9 in only:
-        # the full ladder-shape config-5 exact row: ~196k sequential
-        # marker steps (S=8 x E=24572) — likely longer than a whole
+        # the full ladder-shape config-5 exact rows. The wave form first:
+        # its sequential depth is per-destination conflict count (~in-
+        # degree 3), not the cascade's ~196k total marker steps, so it is
+        # the one that can realistically finish inside a window
+        bench("r5_config5_sf8k_exact_full_wave",
+              ["--graph", "sf", "--nodes", "8192", "--batch", "512",
+               "--phases", "16", "--snapshots", "8", "--scheduler", "exact",
+               "--exact-impl", "wave", "--repeats", "1"],
+              timeout=900.0, full={"batch": 512})
+        # the cascade full row, dead last: likely longer than a whole
         # tunnel window, so it must never queue ahead of anything
         bench("r5_config5_sf8k_exact_full",
               ["--graph", "sf", "--nodes", "8192", "--batch", "512",

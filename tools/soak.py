@@ -130,12 +130,16 @@ def soak_exact(case: int, seed_base: int):
     cfg = SimConfig(queue_capacity=64, max_recorded=128,
                     window_dtype=rng.choice(["int32", "uint16"]))
     # alternate the two delay models the exact scheduler must serve: the
-    # draw-order-sensitive Go stream and the stateless fixed model
+    # draw-order-sensitive Go stream and the stateless fixed model. Fixed
+    # cases also randomize the tick formulation — the wave form only
+    # serves position-addressable samplers, so it enters the battery here
     mk_delay = ((lambda: GoExactDelay(seed_base + case)) if case % 2
                 else (lambda: FixedDelay(1 + case % 5)))
+    impl = "cascade" if case % 2 else rng.choice(["cascade", "wave"])
 
     p_snaps, p_sim = run_events("parity", topo, events, mk_delay())
-    d_snaps, d_sim = run_events("jax", topo, events, mk_delay(), cfg)
+    d_snaps, d_sim = run_events("jax", topo, events, mk_delay(), cfg,
+                                exact_impl=impl)
 
     ok = (p_sim.node_tokens() == d_sim.node_tokens()
           and p_sim.total_tokens() == d_sim.total_tokens()
@@ -147,7 +151,8 @@ def soak_exact(case: int, seed_base: int):
                 ok = False
     log(f"exact case {case}: {'ok' if ok else 'MISMATCH'} "
         f"(n={len(topo.nodes)} events={len(events)} "
-        f"delay={'go' if case % 2 else 'fixed'} win={cfg.window_dtype})")
+        f"delay={'go' if case % 2 else 'fixed'} impl={impl} "
+        f"win={cfg.window_dtype})")
     return ok, cfg.window_dtype
 
 
