@@ -5,7 +5,8 @@ Captures a jax.profiler trace of jitted ticks with state resident on
 device (transfer-free, the same regime the bench measures), converts the
 xplane with xprof, and prints the top HLO ops by self time — the "name the
 dominant op" artifact BASELINE.md's optimization log cites.
-``--scheduler exact`` profiles the cascade tick instead of the sync tick
+``--scheduler exact`` profiles the bit-exact tick (``--exact-impl``
+selects cascade/wave/fold) instead of the sync tick
 (note: bare drained ticks deliver nothing, so for the cascade this shows
 the selection/credit floor; the marker-fold cost only appears under live
 traffic — use ``bench.py --profile`` for a full-storm trace).
@@ -66,6 +67,9 @@ def main() -> None:
     p.add_argument("--reduce-mode", default="auto",
                    choices=["auto", "matmul", "segsum"])
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    p.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
+                   default="cascade",
+                   help="--scheduler exact: tick formulation to profile")
     p.add_argument("--window-dtype", choices=["int32", "uint16"],
                    default="int32")
     p.add_argument("--layouts", choices=["auto", "default"], default="auto",
@@ -107,7 +111,8 @@ def main() -> None:
                                  split_markers=args.scheduler == "sync")
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
                            cfg, make_fast_delay(args.delay, 17),
-                           batch=args.batch, scheduler=args.scheduler)
+                           batch=args.batch, scheduler=args.scheduler,
+                           exact_impl=args.exact_impl)
     print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
           f"scheduler={args.scheduler} mode={runner.kernel._mode}",
           file=sys.stderr)
