@@ -316,6 +316,17 @@ def main() -> None:
                 ["--preset", preset, "--record-dtype", "int16"],
                 3600.0, args.out))
     if 9 in only:
+        # the literal north-star shape under BIT-EXACT reference
+        # semantics: ring-10 x 1M lanes, cascade (at ring's in-degree 1
+        # the wave's per-tick precompute outweighs its parallelism — a
+        # CPU A/B at B=1024 measured cascade 4.30 vs wave 9.11 ms/tick).
+        # Step 9 because a 1M-lane exact warmup is the known wedge-risk
+        # shape (the B=131k variant wedged window 1 on pre-fix code)
+        bench("r5_northstar_exact",
+              ["--graph", "ring", "--nodes", "10", "--batch", "1048576",
+               "--phases", "32", "--snapshots", "1", "--scheduler", "exact",
+               "--delay", "hash", "--repeats", "1"],
+              timeout=600.0, full={"batch": 1048576})
         # the full ladder-shape config-5 exact rows. The wave form first:
         # its sequential depth is per-destination conflict count (~in-
         # degree 3), not the cascade's ~196k total marker steps, so it is
