@@ -727,31 +727,42 @@ class TickKernel:
         dstate0 = s.delay_state
         s = s._replace(delay_state=self.delay.advance_draws(
             dstate0, jnp.sum(draws_e, axis=-1)))
+        # wave number: each pending marker's rank among its destination's
+        # pending markers (fold order within the destination, ANY sid) —
+        # computed ONCE per tick; wave k just masks wnum == k
+        wnum_e = jnp.take(
+            self._seg_excl(jnp.take(mk_pend.astype(_i32), self._by_dst,
+                                    axis=-1)),
+            self._inv_by_dst, axis=-1)                             # [E]
         cc = jnp.arange(C, dtype=_i32)[None, :]
         sid_rows = jnp.arange(S, dtype=_i32)[:, None]              # [S, 1]
-
-        def dstv(wm, x_e):
-            """The (at most one) wave marker per destination's value of a
-            per-edge quantity -> [N] (0 where no marker). Always integer
-            segment sums: draw bases exceed the f32-exact matmul range."""
-            xs = jnp.take(jnp.where(wm, x_e, 0), self._by_dst, axis=-1)
-            return self._segment_sums(xs, self._dst_lo, self._dst_hi)
 
         def cond(carry):
             return jnp.any(carry[1])
 
         def body(carry):
-            s, mk_rem, tok_rem, app = carry
-            # this wave: each destination's first remaining pending marker
-            head_d = self._seg_excl(
-                jnp.take(mk_rem.astype(_i32), self._by_dst, axis=-1))
-            wm = mk_rem & (jnp.take(head_d, self._inv_by_dst, axis=-1) == 0)
-            wdst = dstv(wm, jnp.ones_like(rank_e)) > 0             # [N]
-            wsid_n = dstv(wm, sid_e)                               # [N]
-            wexcl_n = dstv(wm, rank_e)      # the marker's own edge, per dst
+            s, mk_rem, tok_rem, app, k = carry
+            # this wave: each destination's k-th pending marker
+            wm = mk_rem & (wnum_e == k)
+            # the wave marker's per-edge facts, scattered to [N] per
+            # destination in ONE stacked integer segment sum (at most one
+            # marker per destination per wave; f32 matmuls are out — the
+            # draw bases exceed the f32-exact range)
+            stacked = jnp.stack(
+                [wm.astype(_i32),
+                 jnp.where(wm, sid_e, 0),
+                 jnp.where(wm, rank_e, 0),
+                 (wm & first_e).astype(_i32),
+                 jnp.where(wm, base_e, 0)], axis=-2)               # [5, E]
+            per_dst = self._segment_sums(
+                jnp.take(stacked, self._by_dst, axis=-1),
+                self._dst_lo, self._dst_hi)                        # [5, N]
+            wdst = per_dst[..., 0, :] > 0                          # [N]
+            wsid_n = per_dst[..., 1, :]
+            wexcl_n = per_dst[..., 2, :]    # the marker's own edge, per dst
             wrank_n = jnp.where(wdst, wexcl_n, E)    # no marker -> +inf
-            wfirst_n = dstv(wm, first_e.astype(_i32)) > 0          # [N]
-            wbase_n = dstv(wm, base_e)                             # [N]
+            wfirst_n = per_dst[..., 3, :] > 0                      # [N]
+            wbase_n = per_dst[..., 4, :]
             # tokens whose fold rank precedes their destination's marker
             tmask = tok_rem & (rank_e < jnp.take(wrank_n, self._edge_dst,
                                                  axis=-1))
@@ -824,10 +835,11 @@ class TickKernel:
             s = s._replace(
                 done_local=s.done_local | fire,
                 completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32))
-            return s, mk_rem & ~wm, tok_rem, app
+            return s, mk_rem & ~wm, tok_rem, app, k + 1
 
-        s, _, tok_rem, app = lax.while_loop(
-            cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend)))
+        s, _, tok_rem, app, _ = lax.while_loop(
+            cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend),
+                         jnp.int32(0)))
         s = self._credit(s, tok_rem, amt_e)
         app = app | (tok_rem & jnp.any(s.recording, axis=-2))
         log, cnt, err = log_append_masked(

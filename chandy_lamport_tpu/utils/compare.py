@@ -84,3 +84,24 @@ def check_tokens(current_node_tokens: Dict[str, int], snapshots: List[GlobalSnap
             raise SnapshotMismatch(
                 f"snapshot {snap.id}: simulator has {expected} tokens, snapshot has {got}"
             )
+
+
+def dense_state_mismatches(a, b) -> List[str]:
+    """Field names where two DenseState pytrees are not bit-equal — every
+    leaf compared with exact array equality (rings, shared log, recording
+    windows, sticky error mask, and the delay sampler's stream position
+    included). The oracle check behind the exact-tick differentials
+    (tests/test_wave.py, tools/wave_sweep.py): an empty result means the
+    two formulations produced indistinguishable simulations."""
+    import jax
+    import numpy as np
+
+    bad = []
+    for name in a._fields:
+        xs = jax.tree_util.tree_leaves(getattr(a, name))
+        ys = jax.tree_util.tree_leaves(getattr(b, name))
+        if len(xs) != len(ys) or any(
+                not np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(xs, ys)):
+            bad.append(name)
+    return bad

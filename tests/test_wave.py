@@ -57,13 +57,9 @@ def _assert_states_identical(a, b):
     """Every DenseState field bit-equal — including the ring planes, the
     shared log, the recording windows, the sticky error mask, and the
     delay sampler's stream position (the wave's whole claim)."""
-    for name in a._fields:
-        xs = jax.tree_util.tree_leaves(getattr(a, name))
-        ys = jax.tree_util.tree_leaves(getattr(b, name))
-        assert len(xs) == len(ys)
-        for xi, yi in zip(xs, ys):
-            assert np.array_equal(np.asarray(xi), np.asarray(yi)), (
-                f"wave/cascade divergence in DenseState.{name}")
+    from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+
+    assert dense_state_mismatches(a, b) == []
 
 
 @pytest.mark.parametrize("case_seed", range(4))

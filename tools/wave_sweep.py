@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Randomized full-state differential sweep: wave vs cascade exact ticks.
+
+Deeper than the CI battery (tests/test_wave.py): N random configs across
+graph families (ring/ER/scale-free/complete), samplers (hash per-lane
+streams, fixed), window/record dtypes, batch widths, and snapshot
+schedules including same-phase pileups (many same-tick markers per
+destination — the wave's hardest interleaving). Every DenseState field
+must be bit-equal between the two formulations, including the ring
+planes, the shared log, and the delay sampler's stream position.
+
+Usage: JAX_PLATFORMS=cpu python tools/wave_sweep.py [--cases N] [--seed S]
+Exit 0 iff every case matches. Semantics compared: the reference fold,
+/root/reference equivalent sim.go:71-95 + node.go:149-185.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--cases", type=int, default=16)
+    p.add_argument("--seed", type=int, default=9000)
+    args = p.parse_args()
+
+    # the differential is platform-independent; run it on CPU and stay off
+    # the shared TPU tunnel (this image's plugin overrides JAX_PLATFORMS,
+    # so the env var alone is not enough — soak.py does the same)
+    jax.config.update("jax_platforms", "cpu")
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.core.state import DenseTopology
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, HashJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+    from chandy_lamport_tpu.utils.fixtures import TopologySpec
+
+    ok = bad = 0
+    for case in range(args.cases):
+        rng = random.Random(args.seed + case)
+        kind = rng.choice(["ring", "er", "sf", "dense"])
+        n = rng.randrange(6, 48)
+        if kind == "ring":
+            spec = ring_topology(n, tokens=80)
+        elif kind == "er":
+            spec = erdos_renyi(n, rng.uniform(2.0, 5.0), seed=case, tokens=80)
+        elif kind == "sf":
+            spec = scale_free(max(n, 8), 2, seed=case, tokens=80)
+        else:
+            m = rng.randrange(4, 9)
+            spec = TopologySpec(
+                [(f"N{i}", 300) for i in range(m)],
+                sorted((f"N{i}", f"N{j}") for i in range(m)
+                       for j in range(m) if i != j))
+        S = rng.choice([2, 4, 8])
+        cfg = SimConfig(max_snapshots=S,
+                        queue_capacity=rng.choice([16, 24, 48]),
+                        max_recorded=128,
+                        window_dtype=rng.choice(["int32", "uint16"]),
+                        record_dtype=rng.choice(["int32", "int16"]))
+        delay = (HashJaxDelay(seed=rng.randrange(1 << 20)) if case % 3
+                 else FixedJaxDelay(rng.randrange(1, 6)))
+        B = rng.choice([2, 4, 8])
+        phases = rng.randrange(4, 10)
+        # ONE schedule decided before the impl loop (drawing it per impl
+        # compares different workloads — the bug a first draft of this
+        # sweep had)
+        topo = DenseTopology(spec)
+        k = rng.randrange(1, S + 1)
+        sched = ([(0, i % topo.n) for i in range(k)] if case % 2
+                 else staggered_snapshots(topo, k, max_phases=phases))
+        prog = storm_program(topo, phases=phases, amount=2,
+                             snapshot_phases=sched)
+        outs = []
+        for impl in ("cascade", "wave"):
+            r = BatchedRunner(spec, cfg, delay, batch=B, scheduler="exact",
+                              exact_impl=impl)
+            outs.append(jax.device_get(r.run_storm(r.init_batch(), prog)))
+        a, b = outs
+        mismatch = dense_state_mismatches(a, b)
+        if mismatch:
+            bad += 1
+            print(f"case {case}: MISMATCH {sorted(mismatch)} kind={kind} "
+                  f"S={S} B={B} k={k}", flush=True)
+        else:
+            ok += 1
+            print(f"case {case}: ok kind={kind} n={len(spec.nodes)} S={S} "
+                  f"B={B} k={k} delay={type(delay).__name__} "
+                  f"win={cfg.window_dtype} err={int(np.max(a.error))}",
+                  flush=True)
+    print(f"wave sweep: {ok} ok, {bad} mismatched")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
