@@ -231,6 +231,16 @@ def _memory_stats(dev, state_bytes_model: int | None = None) -> dict:
         stats = dev.memory_stats() or {}
         out["hbm_peak_bytes"] = int(stats.get("peak_bytes_in_use", 0))
         out["hbm_limit_bytes"] = int(stats.get("bytes_limit", 0))
+        # the axon tunnel has only ever reported the two keys above as
+        # absent/0 (VERDICT r3 #3); if its PJRT plugin exposes allocator
+        # stats under DIFFERENT names, capture them all — zeros included,
+        # since learning the key set is the whole point — so the next
+        # live window reveals what the plugin actually reports
+        extra = {k: int(v) for k, v in stats.items()
+                 if isinstance(v, (int, float))
+                 and k not in ("peak_bytes_in_use", "bytes_limit")}
+        if extra:
+            out["hbm_allocator_stats"] = extra
     except Exception:
         pass
     try:
