@@ -25,10 +25,14 @@ LABELS = {
     "r5_config4_sf1k_sync_rowmajor": "4: SF-1k sync, row-major layouts",
     "r5_config4_sf1k_sync_auto": "4: SF-1k sync, auto layouts",
     "r5_config4_sf1k_sync_win16": "4: SF-1k sync, uint16 windows",
-    "r5_exact_at_scale_er256": "3: ER-256 exact (hash delay)",
-    "r5_config4_sf1k_exact": "4: SF-1k exact",
+    "r5_exact_at_scale_er256": "3: ER-256 exact, cascade (hash delay)",
+    "r5_exact_at_scale_er256_wave": "3: ER-256 exact, wave (hash delay)",
+    "r5_config4_sf1k_exact": "4: SF-1k exact, cascade",
+    "r5_config4_sf1k_exact_wave": "4: SF-1k exact, wave",
     "r5_config5_sf8k_exact_proof": "5: SF-8k exact proof (S=2, B=8)",
-    "r5_config5_sf8k_exact_full": "5: SF-8k exact, full shape",
+    "r5_config5_sf8k_exact_full_wave": "5: SF-8k exact full shape, wave",
+    "r5_config5_sf8k_exact_full": "5: SF-8k exact full shape, cascade",
+    "r5_northstar_exact": "north star, BIT-EXACT cascade (ring-10 x 1M)",
     "r5_config2_ring10_sync": "2: ring-10 sync B=131k",
     "r5_exact_at_scale_ring10": "2: ring-10 exact B=131k",
     "r5_gshard_base_sf1k_b1": "gshard baseline: SF-1k B=1 unsharded",
