@@ -160,6 +160,43 @@ def test_wave_capacity_edge_matches_cascade():
     _assert_states_identical(outs[0][1]._host(), outs[1][1]._host())
 
 
+def test_wave_push_overflow_matches_cascade():
+    """The wave's vectorized re-broadcast must flag ERR_QUEUE_OVERFLOW at
+    exactly the same boundary as the cascade's sequential _push: a marker
+    cascade pushing onto a ring that is STILL full at push time (no pop
+    made room — the queued tokens are not yet delivery-eligible).
+
+    Construction (FixedDelay(2), C=4): snapshot at N1 at t=0 (marker
+    receive time 2); C tokens N2->N1 sent at t=1 (receive time 3). At
+    t=2 the marker is the only eligible head: N2 creates its local
+    snapshot and re-broadcasts onto the full N2->N1 ring — overflow, in
+    both formulations identically."""
+    from chandy_lamport_tpu.api import run_events
+    from chandy_lamport_tpu.core.dense import DenseBackendError
+
+    C = 4
+    topo = TopologySpec([("N1", 10), ("N2", 10)],
+                        [("N1", "N2"), ("N2", "N1")])
+    events = [SnapshotEvent("N1"), TickEvent(1)]
+    events += [PassTokenEvent("N2", "N1", 1)] * C
+    events += [TickEvent(2)]
+    for impl in ("cascade", "wave"):
+        with pytest.raises(DenseBackendError, match="queue capacity"):
+            run_events("jax", topo, events, FixedDelay(2),
+                       SimConfig(queue_capacity=C, max_recorded=16),
+                       exact_impl=impl)
+    # one more slot: both run clean and bit-identical
+    outs = []
+    for impl in ("cascade", "wave"):
+        snaps, sim = run_events("jax", topo, events, FixedDelay(2),
+                                SimConfig(queue_capacity=C + 1,
+                                          max_recorded=16),
+                                exact_impl=impl)
+        outs.append((snaps, sim))
+    assert outs[0][0] == outs[1][0]
+    _assert_states_identical(outs[0][1]._host(), outs[1][1]._host())
+
+
 def test_wave_refuses_order_dependent_samplers():
     """GoExact (the vendored sequential Go stream) and Uniform (a split
     chain) cannot serve draws by position; wave must fail loudly at
