@@ -116,6 +116,13 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync",
                    help="sync = vectorized simultaneous delivery (production "
                         "path); exact = reference-semantics sequential fold")
+    p.add_argument("--megatick", type=int, default=8,
+                   help="--scheduler exact: K-tick fusion depth for the "
+                        "multi-tick loops (the drain advances K scan-fused "
+                        "ticks per loop iteration, drained stretches fast-"
+                        "forward in O(1); ops/tick.TickKernel docstring). "
+                        "1 disables the fusion; semantics-preserving either "
+                        "way")
     p.add_argument("--capacity", type=int, default=0,
                    help="per-edge queue slots; 0 = size to the workload "
                         "(SimConfig.for_workload)")
@@ -343,7 +350,8 @@ def run_worker(args) -> int:
         runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
                                batch=args.batch, scheduler=args.scheduler,
                                exact_impl=args.exact_impl,
-                               auto_layouts=args.layouts == "auto")
+                               auto_layouts=args.layouts == "auto",
+                               megatick=args.megatick)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -460,6 +468,7 @@ def run_worker(args) -> int:
         "device_kind": dev.device_kind,
         "scheduler": (args.scheduler if args.scheduler == "sync"
                       else f"exact/{args.exact_impl}"),
+        **({"megatick": args.megatick} if args.scheduler == "exact" else {}),
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,

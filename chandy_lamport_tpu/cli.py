@@ -54,24 +54,31 @@ def _cmd_test(args) -> int:
     from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
     from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
 
-    if getattr(args, "exact_impl", "cascade") == "wave":
+    if (args.backend == "jax"
+            and getattr(args, "exact_impl", "cascade") == "wave"):
         # one clear refusal instead of seven per-case failures: the golden
         # suite replays the Go-exact stream, which the wave formulation
-        # refuses by design (order-dependent draws; ops/tick.TickKernel)
+        # refuses by design (order-dependent draws; ops/tick.TickKernel).
+        # jax-only: the parity backend ignores exact_impl entirely, so
+        # ``test --backend parity --exact-impl wave`` runs (ADVICE r5 #2)
         print("the golden suite replays the order-dependent Go-exact "
               "delay stream; exact_impl='wave' cannot serve it — use "
               "cascade or fold (tests/test_wave.py carries the wave's "
               "conformance evidence)", file=sys.stderr)
         return 2
 
+    # the parity oracle has one reference-literal implementation and
+    # api.make_backend refuses the knob there — drop it so parity runs
+    # are impl-flag agnostic
+    impl = (getattr(args, "exact_impl", "cascade")
+            if args.backend == "jax" else "cascade")
     failures = 0
     for top, events, snaps in REFERENCE_TESTS:
         name = events.removesuffix(".events")
         try:
             actual, sim = run_events_file(
                 fixture_path(top), fixture_path(events),
-                backend=args.backend,
-                exact_impl=getattr(args, "exact_impl", "cascade"))
+                backend=args.backend, exact_impl=impl)
             assert len(actual) == len(snaps), (
                 f"{len(actual)} snapshots, expected {len(snaps)}")
             check_tokens(sim.node_tokens(), actual)
@@ -127,7 +134,8 @@ def _cmd_storm(args) -> int:
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
-                           check_every=args.check_every)
+                           check_every=args.check_every,
+                           megatick=args.megatick)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
         snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
@@ -205,6 +213,10 @@ def main(argv=None) -> int:
                          "(ops/tick.TickKernel; 'wave' needs the hash/"
                          "uniform-free samplers — i.e. --delay hash)")
     ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--megatick", type=int, default=8,
+                    help="K-tick fusion depth for the exact path's multi-"
+                         "tick loops (drain + tick-N stretches; ops/tick."
+                         "TickKernel docstring); 1 disables the fusion")
     ps.add_argument("--queue-capacity", type=int, default=0,
                     help="per-edge ring slots; 0 = size to the workload "
                          "(SimConfig.for_workload)")

@@ -51,7 +51,11 @@ class DenseSim:
     def __init__(self, topology: TopologySpec,
                  delay_model: Union[DelayModel, JaxDelay],
                  config: Optional[SimConfig] = None,
-                 exact_impl: str = "cascade"):
+                 exact_impl: str = "cascade", megatick: int = 8):
+        """``megatick``: K-tick fusion depth for ``tick N`` events and the
+        drain loop (ops/tick.TickKernel docstring); semantics-preserving,
+        1 restores the reference-literal one-iteration-per-tick loops (the
+        oracle configuration the megatick differentials compare against)."""
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -62,7 +66,7 @@ class DenseSim:
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
         self.kernel = TickKernel(self.topo, self.config, self.delay,
-                                 exact_impl=exact_impl)
+                                 exact_impl=exact_impl, megatick=megatick)
         self.state: DenseState = init_state(
             self.topo, self.config, self.delay.init_state())
         self._host_cache: Optional[DenseState] = None

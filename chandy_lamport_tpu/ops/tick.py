@@ -177,7 +177,8 @@ class TickKernel:
     """
 
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
-                 marker_mode: str = "ring", exact_impl: str = "cascade"):
+                 marker_mode: str = "ring", exact_impl: str = "cascade",
+                 megatick: int = 8):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -195,9 +196,20 @@ class TickKernel:
         position-addressable delay sampler, JaxDelay.position_streams);
         "fold" is the reference-literal N-step source scan (_tick), kept
         as the specification form the others are differentially tested
-        against."""
+        against.
+
+        megatick fuses the exact path's multi-tick loops: ``run_ticks``
+        (and the exact drain) advance in scan-fused K-tick "megaticks"
+        instead of one loop iteration per tick, with a cumulative
+        quiescence mask — once a lane's rings are empty, every remaining
+        tick is provably a pure time increment, so drained stretches
+        fast-forward in O(1) (see _run_ticks). Semantics-preserving by
+        construction; 1 disables the fusion (the reference-literal
+        one-iteration-per-tick loops)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
+        if megatick < 1:
+            raise ValueError(f"megatick must be >= 1, got {megatick}")
         if exact_impl not in ("cascade", "fold", "wave"):
             raise ValueError(f"unknown exact_impl {exact_impl!r}")
         # only the ring (exact-scheduler) representation ever runs the
@@ -213,6 +225,7 @@ class TickKernel:
                 "FixedJaxDelay or HashJaxDelay (or exact_impl='cascade')")
         self.marker_mode = marker_mode
         self.exact_impl = exact_impl
+        self.megatick = int(megatick)
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -297,11 +310,38 @@ class TickKernel:
         self._exact_tick = {"cascade": self._cascade_tick,
                             "wave": self._wave_tick,
                             "fold": self._tick}[exact_impl]
-        self.tick = jax.jit(self._exact_tick, donate_argnums=0)
-        self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
+        if marker_mode == "split":
+            # a split-mode kernel carries markers in the [S, E] pending
+            # planes, not the rings, so no bit-exact formulation can run on
+            # it. Refuse loudly the moment the exact entry points are
+            # touched (ADVICE r5 #1) — previously these stayed bound to the
+            # exact tick and failed deep inside a trace (a late
+            # NotImplementedError from the wave's sampler guard, or a
+            # silent markers-missing run for cascade/fold).
+            self.tick = self.run_ticks = self._split_mode_exact_stub
+        else:
+            self.tick = jax.jit(self._exact_tick, donate_argnums=0)
+            self.run_ticks = jax.jit(self._run_ticks, donate_argnums=0)
         self.inject_send = jax.jit(self._inject_send, donate_argnums=0)
         self.inject_snapshot = jax.jit(self._inject_snapshot, donate_argnums=0)
-        self.drain_and_flush = jax.jit(self._drain_and_flush, donate_argnums=0)
+        if marker_mode == "split":
+            self.drain_and_flush = self._split_mode_exact_stub
+        else:
+            self.drain_and_flush = jax.jit(self._drain_and_flush,
+                                           donate_argnums=0)
+
+    def _split_mode_exact_stub(self, *_args, **_kwargs):
+        """Bound over tick/run_ticks/drain_and_flush on split-mode kernels:
+        one immediate, explanatory refusal instead of a late trace-time
+        failure (ADVICE r5 #1)."""
+        raise ValueError(
+            "this kernel was built with marker_mode='split' (the sync "
+            "scheduler's representation: markers live in the [S, E] "
+            "pending planes, not the rings), so the bit-exact tick "
+            "formulations cannot run on it — use the sync entry points "
+            "(_sync_tick / _sync_drain_and_flush via BatchedRunner"
+            "(scheduler='sync')), or build the kernel with "
+            "marker_mode='ring' for tick/run_ticks/drain_and_flush")
 
     # ---- static-order segment reductions ---------------------------------
 
@@ -771,16 +811,20 @@ class TickKernel:
             tok_rem = tok_rem & ~tmask
             # repeat markers: close their own channel's window (node.go:
             # 160-164); rec_cnt[e] is live — a marker edge has no pending
-            # append this tick
+            # append this tick. 0/1 counts ride the reduce_mode="auto"
+            # selection the sync tick uses (_sum_by_dst): MXU incidence
+            # matmuls while the [N, E] matrix is small, O(E) integer
+            # segment sums at large N — unlike the stacked rank/base sums
+            # above, whose values exceed the f32-exact range
             rep_se = onehot_se & (wm & ~first_e)[None, :]          # [S, E]
-            rep_sn = self._segment_sums(
-                jnp.take(rep_se.astype(_i32), self._by_dst, axis=-1),
-                self._dst_lo, self._dst_hi)                        # [S, N]
+            rep_sn = self._sum_by_dst(rep_se, amounts=False)       # [S, N]
             first_sn = (sid_rows == wsid_n[None, :]) & wfirst_n[None, :]
             # first markers: CreateLocalSnapshot excluding the marker's
             # link (node.go:58-84), windows opened at the counter each edge
-            # will have once this tick's earlier-rank appends land
-            open_e = (jnp.take(wfirst_n, self._edge_dst, axis=-1)
+            # will have once this tick's earlier-rank appends land; the
+            # bool node->edge broadcasts are mode-aware too (_spread_dst /
+            # _spread_src: MXU in matmul mode, static-index take in segsum)
+            open_e = (self._spread_dst(wfirst_n)
                       & (rank_e != jnp.take(wexcl_n, self._edge_dst,
                                             axis=-1)))
             open_se = ((sid_rows == jnp.take(wsid_n, self._edge_dst,
@@ -807,7 +851,7 @@ class TickKernel:
             # re-broadcast (node.go:97-109): one marker per outbound edge
             # of each first-receipt destination, receive times served from
             # the tick-start stream positions
-            push_g = jnp.take(wfirst_n, self._edge_src, axis=-1)   # [E]
+            push_g = self._spread_src(wfirst_n)                    # [E]
             sid_g = jnp.take(wsid_n, self._edge_src, axis=-1)
             off_g = (jnp.take(wbase_n, self._edge_src, axis=-1)
                      + self._edge_ord_in_src)
@@ -980,11 +1024,80 @@ class TickKernel:
             completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32),
         )
 
+    # ---- fused multi-tick dispatch (the megatick engine) -----------------
+
+    def _quiescent(self, s: DenseState):
+        """Nothing in flight: every ring is empty (ring mode carries
+        markers in the rings too, so empty rings mean NO pending message
+        of either kind). A quiescent exact tick is provably a pure
+        ``time += 1``: delivery selection finds no eligible head, the
+        marker fold runs zero steps, no PRNG draw happens (draws occur
+        only on marker broadcast, which needs a delivery), and the
+        deferred log append is all-masked. Quiescence is also monotone
+        under ticking — a tick can only create messages by delivering a
+        marker, which needs a non-empty ring — which is what lets
+        drained stretches fast-forward. Ring-mode only: the split
+        representation's sync tick draws (S, E) delays every tick, so it
+        is never a pure time increment."""
+        return ~jnp.any(s.q_len > 0, axis=-1)
+
     def _run_ticks(self, s: DenseState, n) -> DenseState:
-        """n is a traced i32 so every distinct ``tick N`` count shares one
-        compilation (fori_loop lowers to while_loop for dynamic bounds)."""
-        return lax.fori_loop(jnp.int32(0), jnp.asarray(n, _i32),
-                             lambda _, s: self._exact_tick(s), s)
+        """n ticks under one dispatch; n is a traced i32 so every distinct
+        ``tick N`` count shares one compilation.
+
+        Every variant carries the quiescence fast-forward: the loop
+        condition exits as soon as a lane has nothing in flight, and the
+        remaining ticks land as one vectorized ``time += n - i`` (per
+        lane under vmap — the while batching rule freezes a finished
+        lane's carry, so each lane's ``i`` records where IT drained).
+        Drained stretches therefore cost O(1) regardless of length.
+
+        With ``megatick`` K > 1 the live stretch advances K ticks per
+        iteration via a ``lax.scan``-fused body with a cumulative
+        quiescence mask (ticks after a mid-scan drain collapse to the
+        time increment the real tick would have been). The ``n % K``
+        remainder runs first as plain ticks so every megatick is FULL —
+        no step is ever masked by the tick count. Fusion pays on the
+        dispatch-bound single-instance path (fewer loop-condition
+        evaluations, real branch skipping); under vmap a masked
+        ``lax.cond`` computes both branches and selects over the whole
+        state — a measured 5.7x drain slowdown at the sf-256 B=64 CPU
+        gauge — so the batched runner defaults to megatick=1
+        (parallel/batch.py) while DenseSim keeps the fused default.
+        Bit-exact either way, by the _quiescent argument."""
+        n = jnp.asarray(n, _i32)
+        K = self.megatick
+
+        def live(c):
+            return (c[1] < n) & ~self._quiescent(c[0])
+
+        def plain(c):
+            return self._exact_tick(c[0]), c[1] + 1
+
+        if K <= 1:
+            s, i = lax.while_loop(live, plain, (s, jnp.int32(0)))
+            return s._replace(time=s.time + (n - i))
+
+        rem = n % K
+        s, i = lax.while_loop(
+            lambda c: (c[1] < rem) & ~self._quiescent(c[0]),
+            plain, (s, jnp.int32(0)))
+
+        def step(carry, _):
+            t, quiet = carry
+            quiet = quiet | self._quiescent(t)
+            t = lax.cond(quiet,
+                         lambda u: u._replace(time=u.time + 1),
+                         self._exact_tick, t)
+            return (t, quiet), None
+
+        def mega(c):
+            (t, _), _ = lax.scan(
+                step, (c[0], jnp.bool_(False)), None, length=K)
+            return t, c[1] + K
+
+        s, i = lax.while_loop(live, mega, (s, i))
+        return s._replace(time=s.time + (n - i))
 
     # ---- event injection (sim.go:58-68) ---------------------------------
 
@@ -1126,24 +1239,40 @@ class TickKernel:
     def _pending(self, s: DenseState):
         return jnp.any(s.started & (s.completed < self.topo.n))
 
-    def _drain_and_flush_with(self, s: DenseState, tick_fn) -> DenseState:
+    def _drain_and_flush_with(self, s: DenseState, tick_fn,
+                              megatick: int = 1) -> DenseState:
         """Tick until every started snapshot has completed on all nodes, then
         max_delay+1 flush ticks. Outcome-equivalent to the reference's
         goroutine drain loop (SURVEY.md §3.5), with a tick-budget guard in
-        place of hanging on a non-strongly-connected graph."""
+        place of hanging on a non-strongly-connected graph.
+
+        ``megatick`` K > 1 fuses K drain ticks per while iteration, each
+        scan step re-checking the drain condition so exactly the same tick
+        sequence executes (a step past completion is the identity — the
+        drain stops ticking, it does not time-advance)."""
         limit = jnp.asarray(s.time + self.cfg.max_ticks, _i32)
 
         def cond(s):
             return self._pending(s) & (s.time < limit)
 
-        s = lax.while_loop(cond, tick_fn, s)
+        if megatick > 1:
+            def body(s):
+                def step(s, _):
+                    return lax.cond(cond(s), tick_fn, lambda t: t, s), None
+
+                s, _ = lax.scan(step, s, None, length=megatick)
+                return s
+        else:
+            body = tick_fn
+        s = lax.while_loop(cond, body, s)
         s = s._replace(error=s.error | jnp.where(
             self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
         return lax.fori_loop(0, self.cfg.max_delay + 1,
                              lambda _, s: tick_fn(s), s)
 
     def _drain_and_flush(self, s: DenseState) -> DenseState:
-        return self._drain_and_flush_with(s, self._exact_tick)
+        return self._drain_and_flush_with(s, self._exact_tick,
+                                          megatick=self.megatick)
 
     def _sync_drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._sync_tick)

@@ -42,6 +42,13 @@ from chandy_lamport_tpu.core.state import (
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 from chandy_lamport_tpu.ops.tick import TickKernel
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
+from chandy_lamport_tpu.utils.layouts import (
+    HAVE_LAYOUTS,
+    array_format,
+    auto_format,
+    format_layout,
+    input_formats,
+)
 
 OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
 
@@ -60,8 +67,8 @@ def _formats_match(tree, formats) -> bool:
     for x, f in zip(xs, fs):
         if f is None:
             continue
-        cur = getattr(x, "format", None)
-        if (cur is None or cur.layout != f.layout
+        cur = array_format(x)
+        if (cur is None or format_layout(cur) != format_layout(f)
                 or cur.sharding != f.sharding):
             return False
     return True
@@ -69,12 +76,16 @@ def _formats_match(tree, formats) -> bool:
 
 class ScriptOps(NamedTuple):
     """A compiled event script: T phases of up to K ops, each phase followed
-    by one tick iff its ``do_tick`` entry is set."""
+    by ``do_tick`` ticks (0 only for a synthetic trailing phase). Multi-tick
+    stretches are carried as COUNTS and executed by the runner's fused
+    multi-tick dispatch (TickKernel._run_ticks on the exact path, with its
+    quiescence fast-forward) instead of the former one-empty-phase-per-tick
+    expansion — a ``tick 200`` event costs one phase, not 200."""
 
     kind: Any      # i32 [T, K]
     arg0: Any      # i32 [T, K]  edge index (send) | node index (snapshot)
     arg1: Any      # i32 [T, K]  token amount (send)
-    do_tick: Any   # i32 [T]     0 only for a synthetic trailing phase
+    do_tick: Any   # i32 [T]     ticks after the phase's ops
 
     @property
     def num_phases(self) -> int:
@@ -83,11 +94,12 @@ class ScriptOps(NamedTuple):
 
 def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
     """Events -> dense op tensors. Each ``tick n`` closes the current phase
-    and appends n-1 empty phases; trailing non-tick events get a final
-    synthetic phase with ``do_tick=0``, so no-drain runs stop exactly where
-    the single-instance backend does (injected but unticked) while drained
-    runs are unaffected (the drain loop supplies the tick, SURVEY.md §3.5)."""
-    phases: List[List[tuple]] = []
+    with a tick count of n (consecutive tick events merge into one phase);
+    trailing non-tick events get a final synthetic phase with ``do_tick=0``,
+    so no-drain runs stop exactly where the single-instance backend does
+    (injected but unticked) while drained runs are unaffected (the drain
+    loop supplies the tick, SURVEY.md §3.5)."""
+    phases: List[Tuple[List[tuple], int]] = []
     cur: List[tuple] = []
     for ev in events:
         if isinstance(ev, PassTokenEvent):
@@ -99,24 +111,24 @@ def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
         elif isinstance(ev, SnapshotEvent):
             cur.append((OP_SNAPSHOT, topo.index[ev.node_id], 0))
         elif isinstance(ev, TickEvent):
-            phases.append(cur)
-            cur = []
-            for _ in range(ev.n - 1):
-                phases.append([])
+            if not cur and phases and phases[-1][1]:
+                phases[-1] = (phases[-1][0], phases[-1][1] + ev.n)
+            else:
+                phases.append((cur, ev.n))
+                cur = []
         else:
             raise TypeError(f"unknown event: {ev!r}")
-    synthetic_final = bool(cur)
-    if cur:
-        phases.append(cur)
-    t = max(len(phases), 1)
-    k = max((len(p) for p in phases), default=0) or 1
+    if cur:  # trailing non-tick events: a synthetic unticked final phase
+        phases.append((cur, 0))
+    if not phases:  # empty script: one bare tick (the pre-count behavior)
+        phases.append(([], 1))
+    t = len(phases)
+    k = max((len(p) for p, _ in phases), default=0) or 1
     kind = np.zeros((t, k), np.int32)
     arg0 = np.zeros((t, k), np.int32)
     arg1 = np.zeros((t, k), np.int32)
-    do_tick = np.ones(t, np.int32)
-    if synthetic_final:
-        do_tick[-1] = 0
-    for i, ops in enumerate(phases):
+    do_tick = np.array([n for _, n in phases], np.int32)
+    for i, (ops, _) in enumerate(phases):
         for j, (op, a0, a1) in enumerate(ops):
             kind[i, j], arg0[i, j], arg1[i, j] = op, a0, a1
     return ScriptOps(kind, arg0, arg1, do_tick)
@@ -134,7 +146,7 @@ class BatchedRunner:
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
                  check_every: int = 0, exact_impl: str = "cascade",
-                 auto_layouts: bool = False):
+                 auto_layouts: bool = False, megatick: int = 1):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -167,7 +179,19 @@ class BatchedRunner:
         boundary-copy-free. Identity on CPU (XLA:CPU picks row-major).
         Default OFF: the perf paths (bench --layouts auto,
         tools/profile_tick.py) opt in; mesh-sharded states
-        (parallel/mesh.shard_batch) use the plain jits."""
+        (parallel/mesh.shard_batch) use the plain jits.
+
+        megatick: K-tick fusion depth for multi-tick dispatch on the
+        exact path (TickKernel docstring) — script ``tick n`` stretches
+        and the exact drain advance K fused ticks per loop iteration.
+        Default 1 HERE (vs DenseSim's fused 8): under vmap every masked
+        ``lax.cond`` computes both branches and selects over the whole
+        batched state, which measured 5.7x SLOWER on the sf-256 B=64
+        CPU drain than the plain per-tick loop — fusion only pays on the
+        dispatch-bound single-instance path. The quiescence fast-forward
+        (drained stretches in O(1)) applies at every K, including 1.
+        Semantics-preserving knob either way; bench --megatick exposes
+        it for the on-device A/B."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -186,14 +210,24 @@ class BatchedRunner:
         self.kernel = TickKernel(
             self.topo, self.config, self.delay,
             marker_mode="split" if scheduler == "sync" else "ring",
-            exact_impl=exact_impl)
+            exact_impl=exact_impl, megatick=megatick)
         if scheduler == "exact":
             self._tick_fn = self.kernel._exact_tick
             self._drain_fn = self.kernel._drain_and_flush
+            # fused multi-tick dispatch: megatick scan + quiescence
+            # fast-forward (TickKernel._run_ticks)
+            self._ticks_fn = self.kernel._run_ticks
         else:
             self._tick_fn = self.kernel._sync_tick
             self._drain_fn = self.kernel._sync_drain_and_flush
+            # the sync tick draws (S, E) delays every tick, so it is never
+            # a pure time increment — no quiescence fast-forward; multi-
+            # tick script stretches still run under one fused loop
+            self._ticks_fn = lambda s, n: lax.fori_loop(
+                jnp.int32(0), jnp.asarray(n, jnp.int32),
+                lambda _, t: self.kernel._sync_tick(t), s)
         self.scheduler = scheduler
+        self.megatick = int(megatick)
         if check_every < 0:
             raise ValueError("check_every must be >= 0 (0 = off)")
         self.check_every = int(check_every)
@@ -202,8 +236,11 @@ class BatchedRunner:
         # (the axon PJRT plugin's ``input_formats`` can disagree with the
         # executable's true parameter layouts for some programs); once
         # tripped, every storm run rides the plain row-major jits and
-        # ``layouts_effective`` reports the degradation
-        self._auto_broken = False
+        # ``layouts_effective`` reports the degradation. Also pre-tripped
+        # when the jax build has no layout API at all (utils/layouts) —
+        # the round-5 exact bench died on that ImportError mid-warmup
+        self._auto_unavailable = bool(auto_layouts) and not HAVE_LAYOUTS
+        self._auto_broken = self._auto_unavailable
         self._storm_aot = {}   # (drain, prog shapes) -> (compiled, relayout)
         self._storm_prog_placed = {}  # same key -> (host values, placed prog)
         self._storm_state_formats = None
@@ -238,9 +275,12 @@ class BatchedRunner:
         'default(auto-rejected)' after the executable rejected the
         ``input_formats``-derived layouts and the runner degraded to the
         row-major jits (bench rows record this, so a fallback can never
-        masquerade as an auto-layout measurement)."""
+        masquerade as an auto-layout measurement); 'default(auto-unavailable)'
+        when this jax build exposes no layout API at all."""
         if not self.auto_layouts:
             return "default"
+        if self._auto_unavailable:
+            return "default(auto-unavailable)"
         return "default(auto-rejected)" if self._auto_broken else "auto"
 
     def storm_state_formats(self):
@@ -321,7 +361,7 @@ class BatchedRunner:
         prog = tuple(jnp.asarray(x) for x in program)
         abstract_state = jax.eval_shape(self._state_builder())
         comp, _ = self._storm_compiled(abstract_state, prog, drain)
-        return comp.input_formats[0][0]
+        return input_formats(comp)[0][0]
 
     def _batched_delay_state(self):
         return self.delay.init_batch_state(self.batch)
@@ -339,7 +379,11 @@ class BatchedRunner:
             ], s)
 
         s = lax.fori_loop(0, kind.shape[0], body, s)
-        return lax.cond(do_tick != 0, self._tick_fn, lambda s: s, s)
+        # do_tick is a COUNT (compile_events): the whole stretch runs under
+        # the fused multi-tick engine instead of one phase per tick
+        return lax.cond(do_tick != 0,
+                        lambda s: self._ticks_fn(s, do_tick),
+                        lambda s: s, s)
 
     def _run_single_no_drain(self, s: DenseState, script: ScriptOps) -> DenseState:
         def phase(s, ops):
@@ -358,6 +402,16 @@ class BatchedRunner:
         until all lanes' snapshots complete + flush."""
         fn = self._run if drain else self._run_no_drain
         return fn(state, ScriptOps(*map(jnp.asarray, script)))
+
+    def run_ticks(self, state: DenseState, n) -> DenseState:
+        """Advance every lane n ticks under one dispatch via the fused
+        multi-tick engine (megatick scan + quiescence fast-forward on the
+        exact path; a fused loop of sync ticks otherwise)."""
+        if not hasattr(self, "_run_ticks_jit"):
+            self._run_ticks_jit = jax.jit(
+                jax.vmap(self._ticks_fn, in_axes=(0, None)),
+                donate_argnums=0)
+        return self._run_ticks_jit(state, jnp.asarray(n, jnp.int32))
 
     # -- storm programs (models/workloads.py): bulk vectorized sends ------
 
@@ -435,7 +489,7 @@ class BatchedRunner:
                 np.array_equal(a, np.asarray(b))
                 for a, b in zip(cached[0], prog)):
             prog = cached[1]
-        if not _formats_match((state, prog), comp.input_formats[0]):
+        if not _formats_match((state, prog), input_formats(comp)[0]):
             # Relayout through a COMPILED identity whose output formats are
             # pinned to the storm executable's input formats. A plain
             # ``jax.device_put(x, format)`` is not reliable here: the axon
@@ -483,9 +537,7 @@ class BatchedRunner:
         key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
         entry = self._storm_aot.get(key)
         if entry is None:
-            from jax.experimental.layout import Format, Layout
-
-            fmt = Format(Layout.AUTO)
+            fmt = auto_format()
             fn = jax.jit(
                 jax.vmap(self._run_storm_single if drain
                          else self._run_storm_phases, in_axes=(0, None)),
@@ -500,10 +552,10 @@ class BatchedRunner:
             # copy-free; the program tensors are tiny, copying them keeps
             # caller-held arrays valid
             relayout = jax.jit(lambda s, p: (s, p), donate_argnums=0,
-                               out_shardings=comp.input_formats[0])
+                               out_shardings=input_formats(comp)[0])
             entry = (comp, relayout)
             self._storm_aot[key] = entry
-            self._storm_state_formats = comp.input_formats[0][0]
+            self._storm_state_formats = input_formats(comp)[0][0]
         return entry
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch

@@ -91,7 +91,8 @@ class ShardedScript(NamedTuple):
       loc   i32 [T, K]  send: local edge index on the owning shard;
                         snapshot: global node index
       arg   i32 [T, K]  send: token amount
-      do_tick i32 [T]   0 only for a synthetic trailing phase
+      do_tick i32 [T]   ticks after the phase (0 only for a synthetic
+                        trailing phase; multi-tick stretches are counts)
     """
 
     kind: Any
@@ -720,10 +721,14 @@ class GraphShardedRunner:
                 return self._bulk_snapshots(s, st, snap_mask)
 
             s = lax.fori_loop(0, kind.shape[0], op, s)
-            # do_tick is replicated, so the cond branch (which contains
-            # collectives) is uniform across shards
+            # do_tick is a replicated COUNT (batch.compile_events carries
+            # multi-tick stretches as counts now), so the cond branch and
+            # its tick loop (which contain collectives) are uniform across
+            # shards
             return lax.cond(do_tick != 0,
-                            lambda s: self._sync_tick(s, st),
+                            lambda s: lax.fori_loop(
+                                0, do_tick,
+                                lambda _, t: self._sync_tick(t, st), s),
                             lambda s: s, s), None
 
         s, _ = lax.scan(phase, s, tuple(script))

@@ -1,0 +1,76 @@
+"""Version-tolerant access to jax's experimental layout API.
+
+The AOT boundary-layout machinery (parallel/batch.BatchedRunner
+auto_layouts, tools/profile_tick.py --layouts auto) was written against
+the ``Format(Layout.AUTO)`` spelling; older jax releases (such as the
+0.4.x line this image ships) expose the same workflow as
+``Layout(DeviceLocalLayout.AUTO)`` with ``Compiled.input_layouts`` and
+``jax.Array.layout``. This module maps both spellings onto one surface so
+the perf paths work — and the bench keeps RUNNING — on either, and
+degrades honestly (``HAVE_LAYOUTS = False`` -> row-major boundaries with
+a labeled ``layouts_effective``) when neither exists, instead of the
+round-5 behavior where one ImportError in the warmup zeroed the whole
+exact-bench axis.
+
+Surface:
+  HAVE_LAYOUTS        whether any layout API is importable
+  auto_format()       the AUTO boundary format for jit in/out_shardings
+  input_formats(comp) a Compiled's input formats, (args, kwargs) pytrees
+  array_format(x)     a live array's format (None for non-device values)
+  format_layout(f)    the device-local layout component of a format
+  concrete_format(major_to_minor, sharding)  a concrete format (tests)
+"""
+
+from __future__ import annotations
+
+try:  # current spelling: Format(Layout.AUTO) / comp.input_formats / x.format
+    from jax.experimental.layout import Format as _Format  # type: ignore
+    from jax.experimental.layout import Layout as _Layout  # type: ignore
+except ImportError:
+    try:  # jax 0.4.x spelling: Layout(DeviceLocalLayout.AUTO) /
+        # comp.input_layouts / x.layout — same workflow, renamed since
+        from jax.experimental.layout import (  # type: ignore
+            DeviceLocalLayout as _Layout,
+            Layout as _Format,
+        )
+    except ImportError:  # no layout API at all: auto-layouts unavailable
+        _Format = _Layout = None
+
+HAVE_LAYOUTS = _Format is not None
+
+
+def auto_format():
+    """The AUTO format object accepted by jit in_shardings/out_shardings."""
+    if not HAVE_LAYOUTS:
+        raise ImportError("jax.experimental.layout is unavailable in this "
+                          "jax build; auto boundary layouts cannot be used")
+    return _Format(_Layout.AUTO)
+
+
+def input_formats(compiled):
+    """A Compiled executable's input formats as ((args...), {kwargs})."""
+    fmts = getattr(compiled, "input_formats", None)
+    if fmts is None:
+        fmts = compiled.input_layouts
+    return fmts
+
+
+def array_format(x):
+    """The live device format of an array (None for host/numpy values)."""
+    fmt = getattr(x, "format", None)
+    if fmt is None:
+        fmt = getattr(x, "layout", None)
+    return fmt
+
+
+def format_layout(fmt):
+    """The device-local layout component of a Format/Layout pair."""
+    dl = getattr(fmt, "device_local_layout", None)
+    return dl if dl is not None else getattr(fmt, "layout", None)
+
+
+def concrete_format(major_to_minor, sharding):
+    """A concrete (non-AUTO) format for the given axis order + sharding."""
+    if not HAVE_LAYOUTS:
+        raise ImportError("jax.experimental.layout is unavailable")
+    return _Format(_Layout(major_to_minor=tuple(major_to_minor)), sharding)

@@ -35,3 +35,11 @@ assert jax.devices()[0].platform == "cpu", (
 assert len(jax.devices()) >= 8, (
     "xla_force_host_platform_device_count=8 did not take effect "
     "(XLA backends were initialized before conftest ran?)")
+
+
+def pytest_configure(config):
+    # the tier-1 gate runs -m 'not slow' (ROADMAP.md): anything beyond the
+    # ~30s-per-test budget carries this marker and runs only in full passes
+    config.addinivalue_line(
+        "markers", "slow: exceeds the tier-1 time budget "
+                   "(deselected by -m 'not slow')")

@@ -1,0 +1,198 @@
+"""Differential coverage for the fused multi-tick engine (ops/tick
+TickKernel megatick) and the batched wave-exact path.
+
+Two claims are pinned here, both bit-level:
+
+1. A K-tick megatick dispatch (``run_ticks`` — lax.scan-fused steps with
+   the cumulative quiescence mask and the O(1) drained-stretch
+   fast-forward) is bit-identical to K sequential ``tick`` calls, for K
+   spanning sub-megatick, one-megatick and multi-megatick counts and for
+   runs that cross the quiescence boundary mid-scan.
+
+2. The fused/batched wave-exact path (BatchedRunner scheduler='exact',
+   exact_impl='wave', compiled scripts with multi-tick stretches, the
+   megatick drain) reproduces the sequential cascade oracle
+   (DenseSim megatick=1 — the reference-literal one-iteration-per-tick
+   loops) bit-exactly on the event scripts of all 7 reference goldens.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.dense import DenseSim
+from chandy_lamport_tpu.core.state import DenseTopology, init_state
+from chandy_lamport_tpu.models.workloads import ring_topology
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, HashJaxDelay
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.parallel.batch import BatchedRunner, compile_events
+from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+from chandy_lamport_tpu.utils.fixtures import (
+    read_events_file,
+    read_topology_file,
+)
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+from chandy_lamport_tpu.utils.randgen import random_strongly_connected
+
+
+def _assert_identical(a, b):
+    assert dense_state_mismatches(a, b) == []
+
+
+def _loaded(megatick, exact_impl="cascade", seed=7):
+    """A kernel + state carrying live traffic and one snapshot in flight
+    (deterministic: every construction of the same args is identical;
+    strongly connected so the drain test cannot run to max_ticks)."""
+    topo = DenseTopology(random_strongly_connected(random.Random(11), 10))
+    cfg = SimConfig(max_snapshots=4, queue_capacity=32, max_recorded=64)
+    delay = HashJaxDelay(seed=seed)
+    kern = TickKernel(topo, cfg, delay, exact_impl=exact_impl,
+                      megatick=megatick)
+    s = init_state(topo, cfg, delay.init_state())
+    for e in range(0, topo.e, 3):
+        s = kern.inject_send(s, np.int32(e), np.int32(2))
+    s = kern.inject_snapshot(s, np.int32(0))
+    return kern, s
+
+
+@pytest.mark.parametrize("k", [1, 3, 17])
+@pytest.mark.parametrize("impl", ["cascade", "wave"])
+def test_megatick_matches_sequential_ticks(k, impl):
+    """K fused ticks == K sequential ticks, every state plane and the
+    sampler stream position included. K=17 runs past the drain point of
+    this workload, so the largest case also exercises the fast-forward."""
+    kern_m, s_m = _loaded(megatick=8, exact_impl=impl)
+    s_m = kern_m.run_ticks(s_m, np.int32(k))
+
+    kern_s, s_s = _loaded(megatick=8, exact_impl=impl)
+    for _ in range(k):
+        s_s = kern_s.tick(s_s)
+
+    a, b = jax.device_get(s_m), jax.device_get(s_s)
+    assert int(a.time) == k
+    _assert_identical(a, b)
+
+
+def test_megatick_crosses_quiescence_boundary_mid_scan():
+    """One delivery at tick 1, then nothing in flight: the quiescence
+    boundary falls inside the first megatick, the rest of the run is
+    fast-forwarded — and the result must still be bit-identical to 17
+    sequential ticks (time advanced the full 17, nothing else moved)."""
+    topo = DenseTopology(ring_topology(4, tokens=20))
+    cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=16)
+
+    def build(megatick):
+        delay = FixedJaxDelay(1)
+        kern = TickKernel(topo, cfg, delay, exact_impl="cascade",
+                          megatick=megatick)
+        s = init_state(topo, cfg, delay.init_state())
+        return kern, kern.inject_send(s, np.int32(0), np.int32(3))
+
+    kern_m, s_m = build(megatick=8)
+    s_m = kern_m.run_ticks(s_m, np.int32(17))
+    kern_s, s_s = build(megatick=8)
+    for _ in range(17):
+        s_s = kern_s.tick(s_s)
+
+    a, b = jax.device_get(s_m), jax.device_get(s_s)
+    assert int(a.time) == 17
+    assert int(np.sum(a.q_len)) == 0      # genuinely quiescent at the end
+    _assert_identical(a, b)
+
+
+def test_megatick_resumes_after_fastforward():
+    """Inject -> fused run past quiescence -> inject again -> fused run:
+    the fast-forwarded state must accept new traffic exactly like the
+    sequentially ticked one (guards against a fast-forward that corrupts
+    anything beyond time)."""
+    def run(fused):
+        kern, s = _loaded(megatick=8 if fused else 1)
+        s = kern.run_ticks(s, np.int32(25))
+        s = kern.inject_send(s, np.int32(1), np.int32(4))
+        s = kern.inject_snapshot(s, np.int32(2))
+        return jax.device_get(kern.run_ticks(s, np.int32(9)))
+
+    _assert_identical(run(fused=True), run(fused=False))
+
+
+def test_megatick_drain_matches_unfused_drain():
+    """The fused drain (K drain ticks per while iteration, each scan step
+    re-checking the drain condition) stops at exactly the same tick and
+    state as the one-tick-per-iteration drain."""
+    def run(megatick):
+        kern, s = _loaded(megatick=megatick, exact_impl="wave")
+        return jax.device_get(kern.drain_and_flush(s))
+
+    a, b = run(8), run(1)
+    _assert_identical(a, b)
+    assert int(np.sum(a.q_len)) == 0
+
+
+_GOLDEN_IDS = [events.removesuffix(".events")
+               for _, events, _ in REFERENCE_TESTS]
+
+
+@pytest.mark.parametrize(
+    "top,events", [(t, e) for t, e, _ in REFERENCE_TESTS], ids=_GOLDEN_IDS)
+def test_batched_wave_matches_sequential_cascade_on_goldens(top, events):
+    """All 7 reference golden scripts through the fused/batched wave path
+    (vmapped wave tick, compiled script with multi-tick stretches, fused
+    megatick drain) vs the sequential cascade oracle (DenseSim,
+    megatick=1). FixedJaxDelay makes every lane's stream identical to the
+    single-instance stream, so EVERY lane must be bit-identical to the
+    oracle's final state — not just decode-equal."""
+    spec = read_topology_file(fixture_path(top))
+    evs = read_events_file(fixture_path(events))
+    cfg = SimConfig(max_snapshots=16, queue_capacity=64, max_recorded=64)
+    batch = 4
+
+    oracle = DenseSim(spec, FixedJaxDelay(2), cfg, exact_impl="cascade",
+                      megatick=1)
+    oracle.run_events(evs)
+    ref = oracle._host()
+
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=batch,
+                           scheduler="exact", exact_impl="wave")
+    final = jax.device_get(
+        runner.run(runner.init_batch(), compile_events(runner.topo, evs)))
+    assert int(np.max(final.error)) == 0
+    for lane in range(batch):
+        _assert_identical(
+            jax.tree_util.tree_map(lambda x: x[lane], final), ref)
+
+
+def test_batched_wave_matches_cascade_on_goldens_hash_lane0():
+    """Same scripts under the production hash sampler (per-lane streams):
+    lane 0 reproduces the single-instance stream exactly, so the batched
+    wave's lane 0 must bit-match the sequential cascade. One combined case
+    keeps the tier-1 budget flat (7 separate compiles would not)."""
+    top, events, _ = REFERENCE_TESTS[5]          # 8nodes-concurrent: densest
+    spec = read_topology_file(fixture_path(top))
+    evs = read_events_file(fixture_path(events))
+    cfg = SimConfig(max_snapshots=16, queue_capacity=64, max_recorded=64)
+
+    oracle = DenseSim(spec, HashJaxDelay(31), cfg, exact_impl="cascade",
+                      megatick=1)
+    oracle.run_events(evs)
+
+    runner = BatchedRunner(spec, cfg, HashJaxDelay(31), batch=4,
+                           scheduler="exact", exact_impl="wave")
+    final = jax.device_get(
+        runner.run(runner.init_batch(), compile_events(runner.topo, evs)))
+    assert int(np.max(final.error)) == 0
+    _assert_identical(jax.tree_util.tree_map(lambda x: x[0], final),
+                      oracle._host())
+
+
+def test_compiled_script_carries_tick_counts():
+    """compile_events folds ``tick n`` into per-phase COUNTS (no more
+    one-empty-phase-per-tick expansion): 3nodes-simple's ``tick`` +
+    ``tick 4`` + trailing send compile to do_tick [1, 4, 0]."""
+    spec = read_topology_file(fixture_path("3nodes.top"))
+    evs = read_events_file(fixture_path("3nodes-simple.events"))
+    script = compile_events(DenseTopology(spec), evs)
+    assert np.asarray(script.do_tick).tolist() == [1, 4, 0]
+    assert script.kind.shape[0] == 3

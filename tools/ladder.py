@@ -56,6 +56,13 @@ def main() -> None:
                    help="shrink batches ~8x for a fast smoke pass")
     p.add_argument("--scheduler", choices=["sync", "exact", "both"],
                    default="sync")
+    p.add_argument("--exact-impl", choices=["cascade", "wave", "both"],
+                   default="cascade",
+                   help="bit-exact formulation(s) for the ladder's exact "
+                        "rows (forwarded to bench --exact-impl); 'both' "
+                        "runs a cascade/wave A/B pair per config — the "
+                        "wave is the competitive exact number at marker-"
+                        "heavy shapes (ops/tick._wave_tick)")
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--delay", choices=["uniform", "hash"], default=None,
                    help="forwarded to bench --delay")
@@ -79,23 +86,39 @@ def main() -> None:
     ]
     schedulers = (["sync", "exact"] if args.scheduler == "both"
                   else [args.scheduler])
+    impls = (["cascade", "wave"] if args.exact_impl == "both"
+             else [args.exact_impl])
     n = 0
     for name, extra in ladder:
         for sched in schedulers:
-            run = list(extra)
-            # (round 4) exact runs at the full sync batch: the cascade tick
-            # (ops/tick._cascade_tick) removed the N-step per-tick scan
-            # whose live carries cost ~8x the sync path's HBM and faulted
-            # the device at N=8192 — the old /8 clamp is gone
-            if args.delay:
-                run += ["--delay", args.delay]
-            row = bench(f"{name}_{sched}", run + ["--scheduler", sched],
-                        args.timeout)
-            print(json.dumps(row), flush=True)
-            # append immediately so a later config's crash loses nothing
-            with open(args.out, "a") as f:
-                f.write(json.dumps(row) + "\n")
-            n += 1
+            # one rung per exact formulation (sync ignores the impl axis);
+            # row names keep the historical `{config}_exact` spelling for
+            # the cascade so banked-row resume logic elsewhere still hits
+            for impl in (impls if sched == "exact" else ["cascade"]):
+                run = list(extra)
+                # (round 4) exact runs at the full sync batch: the cascade
+                # tick (ops/tick._cascade_tick) removed the N-step per-tick
+                # scan whose live carries cost ~8x the sync path's HBM and
+                # faulted the device at N=8192 — the old /8 clamp is gone
+                if args.delay:
+                    run += ["--delay", args.delay]
+                run += ["--scheduler", sched]
+                label = f"{name}_{sched}"
+                if sched == "exact":
+                    # the wave needs a position-addressable sampler; the
+                    # bench default (hash) is one, but pin it so a future
+                    # --delay uniform pass can't silently break the rung
+                    run += ["--exact-impl", impl]
+                    if not args.delay:
+                        run += ["--delay", "hash"]
+                    if impl != "cascade":
+                        label += f"_{impl}"
+                row = bench(label, run, args.timeout)
+                print(json.dumps(row), flush=True)
+                # append immediately so a later config's crash loses nothing
+                with open(args.out, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+                n += 1
     print(f"appended {n} rows to {args.out}", file=sys.stderr)
 
 

@@ -70,6 +70,9 @@ def main() -> None:
     p.add_argument("--exact-impl", choices=["cascade", "wave", "fold"],
                    default="cascade",
                    help="--scheduler exact: tick formulation to profile")
+    p.add_argument("--megatick", type=int, default=8,
+                   help="--scheduler exact: K-tick fusion depth for the "
+                        "per-stage megatick timing (ops/tick.TickKernel)")
     p.add_argument("--window-dtype", choices=["int32", "uint16"],
                    default="int32")
     p.add_argument("--layouts", choices=["auto", "default"], default="auto",
@@ -112,7 +115,8 @@ def main() -> None:
     runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
                            cfg, make_fast_delay(args.delay, 17),
                            batch=args.batch, scheduler=args.scheduler,
-                           exact_impl=args.exact_impl)
+                           exact_impl=args.exact_impl,
+                           megatick=args.megatick)
     print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
           f"scheduler={args.scheduler} mode={runner.kernel._mode}",
           file=sys.stderr)
@@ -120,12 +124,21 @@ def main() -> None:
     # donation matches the production jits (TickKernel.tick / run_storm):
     # without it the profiled executable cannot alias state buffers and
     # runs in a different (2x-resident) HBM regime than the bench
-    jit_kw = {"donate_argnums": 0}
-    if args.layouts == "auto":
-        from jax.experimental.layout import Format, Layout
+    from chandy_lamport_tpu.utils.layouts import (
+        HAVE_LAYOUTS,
+        array_format,
+        auto_format,
+        format_layout,
+    )
 
-        jit_kw.update(in_shardings=Format(Layout.AUTO),
-                      out_shardings=Format(Layout.AUTO))
+    jit_kw = {"donate_argnums": 0}
+    if args.layouts == "auto" and not HAVE_LAYOUTS:
+        print("auto layouts unavailable in this jax build; profiling "
+              "row-major boundaries", file=sys.stderr)
+        args.layouts = "default"
+    if args.layouts == "auto":
+        fmt = auto_format()
+        jit_kw.update(in_shardings=fmt, out_shardings=fmt)
     tick = jax.jit(jax.vmap(runner._tick_fn), **jit_kw)
     s = runner.init_batch_device()
     s = tick(s)
@@ -136,10 +149,10 @@ def main() -> None:
     jax.block_until_ready(s)
     if args.layouts == "auto":
         nondefault = [
-            f"{np.shape(x)}:{x.format.layout.major_to_minor}"
+            f"{np.shape(x)}:{format_layout(array_format(x)).major_to_minor}"
             for x in jax.tree_util.tree_leaves(s)
-            if hasattr(x, "format") and np.ndim(x) > 0
-            and x.format.layout.major_to_minor
+            if array_format(x) is not None and np.ndim(x) > 0
+            and format_layout(array_format(x)).major_to_minor
             != tuple(range(np.ndim(x)))]
         print(f"auto layouts: {len(nondefault)} non-row-major state "
               f"leaves {nondefault[:6]}", file=sys.stderr)
@@ -154,6 +167,55 @@ def main() -> None:
     print(f"per-tick (untraced): {per_tick * 1e3:.2f} ms -> "
           f"{args.batch * runner.topo.n / per_tick / 1e6:.1f}M node-ticks/s",
           file=sys.stderr)
+
+    if args.scheduler == "exact":
+        # per-stage wall-clock of the fused exact path: how much of a
+        # dispatch is tick-start delivery selection (_select_and_pop, the
+        # shared cascade/wave front half) vs the sequential marker phase
+        # (full tick minus selection) vs the K-tick megatick's scan glue
+        # (amortized per-tick megatick cost vs a bare tick). Bare drained
+        # states deliver nothing, so this is the selection/fold floor —
+        # use bench.py --profile for a live-traffic trace.
+        import jax.numpy as jnp
+
+        k = runner.kernel
+
+        def select_only(t):
+            t = t._replace(time=t.time + 1)
+            return k._select_and_pop(t)[0]
+
+        stages = [
+            ("delivery-select", jax.jit(jax.vmap(select_only),
+                                        donate_argnums=0)),
+            ("full-tick", jax.jit(jax.vmap(runner._tick_fn),
+                                  donate_argnums=0)),
+            (f"megatick-x{k.megatick}", jax.jit(
+                jax.vmap(lambda t: k._run_ticks(t, jnp.int32(k.megatick))),
+                donate_argnums=0)),
+        ]
+        timings = {}
+        for name, fn in stages:
+            st = runner.init_batch_device()
+            st = fn(st)                      # compile + warm
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            for _ in range(max(args.ticks // 2, 3)):
+                st = fn(st)
+            jax.block_until_ready(st)
+            timings[name] = ((time.perf_counter() - t0)
+                             / max(args.ticks // 2, 3))
+        sel = timings["delivery-select"]
+        full = timings["full-tick"]
+        mega = timings[f"megatick-x{k.megatick}"]
+        print(f"exact-stage breakdown ({args.exact_impl}):", file=sys.stderr)
+        print(f"  delivery-select          {sel * 1e3:9.3f} ms",
+              file=sys.stderr)
+        print(f"  marker phase (full-sel)  {(full - sel) * 1e3:9.3f} ms",
+              file=sys.stderr)
+        print(f"  megatick x{k.megatick} per tick     "
+              f"{mega / k.megatick * 1e3:9.3f} ms "
+              f"(dispatch {mega * 1e3:.3f} ms, bare tick "
+              f"{full * 1e3:.3f} ms)", file=sys.stderr)
 
     jax.profiler.start_trace(args.out)
     for _ in range(args.ticks):
