@@ -116,13 +116,29 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync",
                    help="sync = vectorized simultaneous delivery (production "
                         "path); exact = reference-semantics sequential fold")
-    p.add_argument("--megatick", type=int, default=8,
+    p.add_argument("--megatick", type=int, default=1,
                    help="--scheduler exact: K-tick fusion depth for the "
                         "multi-tick loops (the drain advances K scan-fused "
                         "ticks per loop iteration, drained stretches fast-"
                         "forward in O(1); ops/tick.TickKernel docstring). "
-                        "1 disables the fusion; semantics-preserving either "
-                        "way")
+                        "Default 1: the bench is the BATCHED path, where "
+                        "the fused scan's masked lax.cond computes both "
+                        "branches per step under vmap — the measured "
+                        "sf-256 B=64 wave gauge ran 2.2x faster unfused "
+                        "(the same asymmetry behind BatchedRunner's "
+                        "megatick=1 default; K>1 pays only on the "
+                        "dispatch-bound single-instance path). "
+                        "Semantics-preserving either way")
+    p.add_argument("--queue-engine", choices=["auto", "gather", "mask"],
+                   default="auto",
+                   help="ring-queue addressing (ops/tick.TickKernel): "
+                        "'gather' = O(E) packed-plane head gathers + append "
+                        "scatters, 'mask' = the O(E·C) one-hot formulation, "
+                        "'auto' (default) = backend-resolved (gather on "
+                        "TPU, mask on CPU where XLA serializes scatters — "
+                        "ops/tick.resolve_queue_engine). Bit-identical "
+                        "results; the JSON row's queue_engine field "
+                        "records the RESOLVED engine")
     p.add_argument("--capacity", type=int, default=0,
                    help="per-edge queue slots; 0 = size to the workload "
                         "(SimConfig.for_workload)")
@@ -351,7 +367,8 @@ def run_worker(args) -> int:
                                batch=args.batch, scheduler=args.scheduler,
                                exact_impl=args.exact_impl,
                                auto_layouts=args.layouts == "auto",
-                               megatick=args.megatick)
+                               megatick=args.megatick,
+                               queue_engine=args.queue_engine)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -469,6 +486,7 @@ def run_worker(args) -> int:
         "scheduler": (args.scheduler if args.scheduler == "sync"
                       else f"exact/{args.exact_impl}"),
         **({"megatick": args.megatick} if args.scheduler == "exact" else {}),
+        "queue_engine": runner.queue_engine,
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -558,7 +576,8 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         log(f"--nodes {args.nodes} not divisible by {args.graphshard} shards")
         return 1
     mesh = Mesh(np.array(devs[:args.graphshard]), ("graph",))
-    runner = GraphShardedRunner(spec, cfg, mesh, seed=17)
+    runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
+                                queue_engine=args.queue_engine)
     topo = runner.topo
     log(f"graphshard: {topo.n} nodes / {args.graphshard} shards "
         f"({runner.nl} nodes, {runner.em} edge slots per shard), "
@@ -595,7 +614,8 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                   max_recorded=2 * cfg.max_recorded)
         log(f"retrying with queue_capacity={cfg.queue_capacity}, "
             f"max_recorded={cfg.max_recorded}")
-        runner = GraphShardedRunner(spec, cfg, mesh, seed=17)
+        runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
+                                    queue_engine=args.queue_engine)
 
     times, ticks_seen = [], []
     mem = {}
@@ -629,6 +649,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "scheduler": "sync",
+        "queue_engine": runner.queue_engine,
         "mode": "graphshard",
         "graphshard": args.graphshard,
         "graph": args.graph,

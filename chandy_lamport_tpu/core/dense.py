@@ -51,11 +51,15 @@ class DenseSim:
     def __init__(self, topology: TopologySpec,
                  delay_model: Union[DelayModel, JaxDelay],
                  config: Optional[SimConfig] = None,
-                 exact_impl: str = "cascade", megatick: int = 8):
+                 exact_impl: str = "cascade", megatick: int = 8,
+                 queue_engine: str = "auto"):
         """``megatick``: K-tick fusion depth for ``tick N`` events and the
         drain loop (ops/tick.TickKernel docstring); semantics-preserving,
         1 restores the reference-literal one-iteration-per-tick loops (the
-        oracle configuration the megatick differentials compare against)."""
+        oracle configuration the megatick differentials compare against).
+        ``queue_engine``: ring-queue addressing (TickKernel docstring) —
+        "gather" O(E) gathers/scatters, "mask" one-hot, or "auto"
+        (default, backend-resolved); bit-identical results."""
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -66,7 +70,8 @@ class DenseSim:
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
         self.kernel = TickKernel(self.topo, self.config, self.delay,
-                                 exact_impl=exact_impl, megatick=megatick)
+                                 exact_impl=exact_impl, megatick=megatick,
+                                 queue_engine=queue_engine)
         self.state: DenseState = init_state(
             self.topo, self.config, self.delay.init_state())
         self._host_cache: Optional[DenseState] = None
@@ -125,7 +130,7 @@ class DenseSim:
             head, length = int(host.q_head[e]), int(host.q_len[e])
             for k in range(length):
                 slot = (head + k) % C
-                if not host.q_marker[e, slot]:
+                if not int(host.q_meta[e, slot]) & 1:
                     total += int(host.q_data[e, slot])
         return total
 

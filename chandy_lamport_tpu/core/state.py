@@ -17,8 +17,16 @@ Topology encoding (static per run, baked into the jitted kernel):
 
 State encoding (the jit carry; one instance — batching vmaps the whole tuple):
   - per-edge ring buffers replace the FIFO queues (queue.go:6-28):
-    ``q_*[E, C]`` + ``q_head[E]`` + ``q_len[E]``, append at
-    (head+len) % C, pop at head — FIFO with head-of-line blocking intact;
+    ``q_meta/q_data[E, C]`` + ``q_head[E]`` + ``q_len[E]``, append at
+    (head+len) % C, pop at head — FIFO with head-of-line blocking intact.
+    The per-slot payload is PACKED into two int32 planes: ``q_meta`` carries
+    ``rtime << 1 | is_marker`` (pack_meta; rtime bounded by RTIME_PACK_LIMIT,
+    guarded by ERR_VALUE_OVERFLOW at push) and ``q_data`` keeps the full-
+    range token amount / snapshot id, so a head's eligibility+kind read is
+    ONE [E] gather of q_meta (plus one of q_data for the payload) instead of
+    the former three O(E·C) one-hot mask reductions over separate
+    marker/rtime/data planes — HBM traffic per tick scales with edge count,
+    not queue capacity (ops/tick.TickKernel queue_engine docstring);
   - snapshot slot s holds snapshot id s (ids are allocated sequentially from
     0, reference sim.go:107-108, so slot==id while id < S);
   - ``recording[S, E]`` replaces per-snapshot ``isLinkRecording`` maps
@@ -57,6 +65,32 @@ ERR_CONSERVATION = 64
 # unaffected)
 F32_EXACT_LIMIT = 1 << 24
 
+# largest receive time the packed ring-slot plane can carry: q_meta stores
+# rtime << 1 | is_marker in one int32, so rtime loses the sign bit and one
+# payload bit. rtime = time + 1 + delay, so this binds total simulated time
+# (~10^9 ticks — four orders of magnitude past the max_ticks drain budget);
+# push sites fire ERR_VALUE_OVERFLOW at the bound instead of wrapping.
+RTIME_PACK_LIMIT = 1 << 30
+
+
+def pack_meta(rtime, marker):
+    """One packed ring-slot metadata word: ``rtime << 1 | is_marker``.
+    THE layout definition — every producer (scalar push, batched append,
+    both runners) and consumer (head gathers, pops, metrics, decode) goes
+    through pack_meta/meta_rtime/meta_marker so the encoding cannot drift.
+    Works on numpy and jnp operands (and python-bool ``marker``)."""
+    return rtime * 2 + marker
+
+
+def meta_rtime(meta):
+    """Delivery-eligible time of a packed slot word."""
+    return meta >> 1
+
+
+def meta_marker(meta):
+    """Marker bit of a packed slot word (bool)."""
+    return (meta & 1) == 1
+
 ERROR_NAMES = {
     ERR_QUEUE_OVERFLOW: "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)",
     ERR_SNAPSHOT_OVERFLOW: "concurrent snapshot slots exceeded (raise SimConfig.max_snapshots)",
@@ -70,7 +104,9 @@ ERROR_NAMES = {
                         "record_dtype='int32'), or an edge's token-push "
                         "counter reached the FIFO merge-key bound "
                         "(ops/tick.merge_key_limit — fewer tokens per edge "
-                        "or a smaller max_snapshots)",
+                        "or a smaller max_snapshots), or a receive time "
+                        "reached the packed ring-slot bound "
+                        "(state.RTIME_PACK_LIMIT, ~10^9 simulated ticks)",
     ERR_CONSERVATION: "in-run token-conservation check failed "
                       "(node balances + in-flight != initial total; "
                       "BatchedRunner check_every — the reference's "
@@ -146,12 +182,22 @@ class DenseState(NamedTuple):
     window's data (``rec_cnt - min_prot > L``, where ``min_prot`` is the
     earliest window start on the edge) fires ERR_RECORD_OVERFLOW.
 
+    **Packed ring slots.** Each ring slot is two int32 words: ``q_meta``
+    = ``rtime << 1 | is_marker`` (pack_meta/meta_rtime/meta_marker) and
+    ``q_data`` = the token amount or snapshot id. Packing the marker bit
+    into the rtime word drops one whole [E, C] plane (the former bool
+    ``q_marker``) and makes a head's eligibility+kind read a single
+    gather. Bounds: rtime < RTIME_PACK_LIMIT (2^30 — four orders of
+    magnitude past the max_ticks drain budget; push sites fire
+    ERR_VALUE_OVERFLOW at the bound), while ``q_data`` keeps the full
+    int32 range so token amounts/snapshot ids are never narrowed.
+
     Channel state exists in two representations, selected by the kernel's
     ``marker_mode`` (ops/tick.TickKernel):
 
     - **ring** (the exact scheduler): tokens AND markers share the ring
-      buffers ``q_*`` in push order, exactly like the reference's per-link
-      FIFO (queue.go:6-28); ``m_*`` stay zero.
+      buffers ``q_meta/q_data`` in push order, exactly like the reference's
+      per-link FIFO (queue.go:6-28); ``m_*`` stay zero.
     - **split** (the sync scheduler): the ring carries only tokens, and
       markers — of which each (snapshot, edge) pair ever holds at most ONE
       (a node broadcasts an id only on first receipt, node.go:154-156) —
@@ -174,9 +220,9 @@ class DenseState(NamedTuple):
 
     time: Any          # i32 []
     tokens: Any        # i32 [N]
-    q_marker: Any      # bool [E, C]  ring mode only (False throughout in split)
+    q_meta: Any        # i32 [E, C]   rtime << 1 | is_marker (pack_meta;
+    #                    marker bit only ever set in ring mode)
     q_data: Any        # i32 [E, C]   token amount | snapshot id (ring mode)
-    q_rtime: Any       # i32 [E, C]   delivery-eligible time
     q_head: Any        # i32 [E]
     q_len: Any         # i32 [E]
     tok_pushed: Any    # i32 [E]      tokens ever pushed (split-mode order)
@@ -209,9 +255,8 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
     return DenseState(
         time=np.int32(0),
         tokens=topo.tokens0.copy(),
-        q_marker=np.zeros((e, c), b),
+        q_meta=np.zeros((e, c), i32),
         q_data=np.zeros((e, c), i32),
-        q_rtime=np.zeros((e, c), i32),
         q_head=np.zeros(e, i32),
         q_len=np.zeros(e, i32),
         tok_pushed=np.zeros(e, i32),

@@ -45,6 +45,10 @@ from chandy_lamport_tpu.core.state import (
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
     F32_EXACT_LIMIT,
+    RTIME_PACK_LIMIT,
+    meta_marker,
+    meta_rtime,
+    pack_meta,
 )
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 
@@ -91,6 +95,25 @@ def count_dtype(topo: DenseTopology, override: str = "auto",
     if backend == "tpu" and degree_bound <= BF16_EXACT_COUNT:
         return jnp.bfloat16
     return jnp.float32
+
+
+def resolve_queue_engine(engine: str, backend: str | None = None) -> str:
+    """Resolve the ring-queue addressing knob (TickKernel / the sharded
+    runner): "auto" picks "gather" on TPU — where the O(E) packed-plane
+    gathers/scatters beat the O(E·C) one-hot traffic as capacity grows —
+    and "mask" elsewhere: XLA:CPU lowers the vectorized ``.at[edge, pos]``
+    append scatter to a serial update loop measured ~4x SLOWER than the
+    SIMD one-hot select at the bench shapes (tools/profile_tick.py
+    "queue ops" A/B), the same backend asymmetry count_dtype gates on.
+    ``backend`` defaults to the live jax backend; parameterized so CI can
+    pin the TPU decision from the CPU mesh."""
+    if engine not in ("auto", "gather", "mask"):
+        raise ValueError(f"unknown queue_engine {engine!r}")
+    if engine != "auto":
+        return engine
+    if backend is None:
+        backend = jax.default_backend()
+    return "gather" if backend == "tpu" else "mask"
 
 
 def merge_keymult(max_snapshots: int) -> int:
@@ -178,7 +201,7 @@ class TickKernel:
 
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
                  marker_mode: str = "ring", exact_impl: str = "cascade",
-                 megatick: int = 8):
+                 megatick: int = 8, queue_engine: str = "auto"):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -205,9 +228,24 @@ class TickKernel:
         tick is provably a pure time increment, so drained stretches
         fast-forward in O(1) (see _run_ticks). Semantics-preserving by
         construction; 1 disables the fusion (the reference-literal
-        one-iteration-per-tick loops)."""
+        one-iteration-per-tick loops).
+
+        queue_engine selects the ring-queue addressing, bit-identical
+        either way (tests/test_queue_engine.py): "gather" reads heads
+        with O(E) ``take_along_axis`` gathers (_head_fields) and appends
+        with O(E) ``.at[edge, pos]`` scatters (_append_rows), so per-tick
+        queue HBM traffic scales with EDGE COUNT; "mask" is the
+        one-hot formulation — [E, C] mask reductions/selects whose
+        traffic scales with queue CAPACITY, but SIMD-friendly where
+        XLA serializes scatters. "auto" (default) resolves per backend
+        (resolve_queue_engine: gather on TPU, mask elsewhere — the
+        measured XLA:CPU scatter penalty); ``self.queue_engine`` holds
+        the RESOLVED engine, and the non-default one stays available as
+        the differential oracle and the tools/profile_tick.py
+        "queue ops" A/B."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
+        queue_engine = resolve_queue_engine(queue_engine)
         if megatick < 1:
             raise ValueError(f"megatick must be >= 1, got {megatick}")
         if exact_impl not in ("cascade", "fold", "wave"):
@@ -226,6 +264,7 @@ class TickKernel:
         self.marker_mode = marker_mode
         self.exact_impl = exact_impl
         self.megatick = int(megatick)
+        self.queue_engine = queue_engine
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -386,18 +425,85 @@ class TickKernel:
 
     # ---- queue primitives ------------------------------------------------
 
+    def _head_fields(self, s: DenseState):
+        """Every ring head's (rtime, is_marker, data), addressed by
+        ``queue_engine``: ONE [E] gather per packed plane
+        (``take_along_axis`` at q_head), or the legacy [E, C] one-hot mask
+        reductions. Heads of empty queues read their stale slot either way
+        (callers gate on q_len > 0), so the engines are bit-identical."""
+        if self.queue_engine == "gather":
+            head_meta = jnp.take_along_axis(
+                s.q_meta, s.q_head[:, None], axis=-1)[..., 0]
+            head_data = jnp.take_along_axis(
+                s.q_data, s.q_head[:, None], axis=-1)[..., 0]
+        else:
+            cc = jnp.arange(self.cfg.queue_capacity, dtype=_i32)[None, :]
+            head_hit = cc == s.q_head[:, None]                    # [E, C]
+            head_meta = jnp.sum(jnp.where(head_hit, s.q_meta, 0), axis=-1,
+                                dtype=_i32)
+            head_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
+                                dtype=_i32)
+        return meta_rtime(head_meta), meta_marker(head_meta), head_data
+
+    def _append_rows(self, s: DenseState, active, rt_e, mk_e,
+                     data_e) -> DenseState:
+        """THE batched ring append: one message on every edge where
+        ``active`` (at most one per edge — callers are per-source-row,
+        per-wave or per-phase chunks), with receive times ``rt_e`` already
+        drawn by the caller (so every draw-order discipline routes through
+        one write primitive). Addressing by ``queue_engine``: a single
+        vectorized ``.at[edge, pos]`` scatter per packed plane (inactive
+        rows aim at column C and drop — no read-modify-write of old
+        slots), or the legacy [E, C] one-hot selects. Flags queue/merge-key
+        overflow exactly like the scalar push, plus the packed-rtime bound
+        (RTIME_PACK_LIMIT)."""
+        C = self.cfg.queue_capacity
+        rt_e = jnp.asarray(rt_e, _i32)
+        data_e = jnp.broadcast_to(jnp.asarray(data_e, _i32), active.shape)
+        meta_e = pack_meta(rt_e, mk_e)
+        err = (jnp.any(active & (s.q_len >= C)).astype(_i32)
+               * ERR_QUEUE_OVERFLOW
+               | (jnp.any(active & (s.tok_pushed >= self._key_limit))
+                  | jnp.any(active & (rt_e >= RTIME_PACK_LIMIT))
+                  ).astype(_i32) * ERR_VALUE_OVERFLOW)
+        pos = (s.q_head + s.q_len) % C
+        if self.queue_engine == "gather":
+            tgt = jnp.where(active, pos, C)   # inactive -> OOB, dropped
+            q_meta = s.q_meta.at[self._rows_e, tgt].set(
+                jnp.broadcast_to(meta_e, active.shape),
+                mode="drop", unique_indices=True)
+            q_data = s.q_data.at[self._rows_e, tgt].set(
+                data_e, mode="drop", unique_indices=True)
+        else:
+            hit = active[:, None] & (jnp.arange(C, dtype=_i32)[None, :]
+                                     == pos[:, None])             # [E, C]
+            q_meta = jnp.where(hit, jnp.broadcast_to(
+                meta_e, active.shape)[:, None], s.q_meta)
+            q_data = jnp.where(hit, data_e[:, None], s.q_data)
+        return s._replace(
+            q_meta=q_meta,
+            q_data=q_data,
+            q_len=s.q_len + active.astype(_i32),
+            tok_pushed=s.tok_pushed + active.astype(_i32),
+            error=s.error | err,
+        )
+
     def _push(self, s: DenseState, e, is_marker: bool, data) -> DenseState:
         """Append to edge e's ring buffer with one delay draw
         (node.go:126-130 / node.go:104-108)."""
         rtime, dstate = self.delay.draw(s.delay_state, s.time)
         C = self.cfg.queue_capacity
+        rtime = jnp.asarray(rtime, _i32)
         pos = (s.q_head[e] + s.q_len[e]) % C
         err = s.error | jnp.where(s.q_len[e] >= C, ERR_QUEUE_OVERFLOW, 0).astype(_i32)
-        err = err | jnp.where(s.tok_pushed[e] >= self._key_limit,
+        err = err | jnp.where((s.tok_pushed[e] >= self._key_limit)
+                              | (rtime >= RTIME_PACK_LIMIT),
                               ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        s = s._replace(
+        # split-mode rings never hold markers (_push is token-only there),
+        # so the packed marker bit is correct in both modes
+        return s._replace(
+            q_meta=s.q_meta.at[e, pos].set(pack_meta(rtime, is_marker)),
             q_data=s.q_data.at[e, pos].set(jnp.asarray(data, _i32)),
-            q_rtime=s.q_rtime.at[e, pos].set(jnp.asarray(rtime, _i32)),
             q_len=s.q_len.at[e].add(1),
             # split-mode merge-order counter; meaningless (but harmless) in
             # ring mode, where _push also carries markers and FIFO order is
@@ -406,9 +512,6 @@ class TickKernel:
             delay_state=dstate,
             error=err,
         )
-        if self.marker_mode == "split" and not is_marker:
-            return s  # split-mode rings never hold markers (all-False plane)
-        return s._replace(q_marker=s.q_marker.at[e, pos].set(is_marker))
 
     def _push_marker(self, s: DenseState, e, sid) -> DenseState:
         """Scalar marker enqueue, routed by marker_mode: into the ring
@@ -458,13 +561,50 @@ class TickKernel:
 
     def _broadcast_markers(self, s: DenseState, node, sid) -> DenseState:
         """SendToNeighbors (node.go:97-109): marker on every outbound link in
-        dest order, one delay draw per real link (padding slots draw nothing)."""
-        def body(k, s):
-            e = self._edge_table[node, k]
-            return lax.cond(e >= 0,
-                            lambda s: self._push_marker(s, e, sid),
-                            lambda s: s, s)
-        return lax.fori_loop(0, self.topo.d, body, s)
+        dest order, one delay draw per real link (padding slots draw
+        nothing). Ring mode enqueues the whole row through ONE batched
+        append (_append_rows) instead of D scalar pushes: the delay draws
+        keep their sequential dest-order stream positions (served
+        positionally for position-addressable samplers, by a scan that
+        threads only the sampler state otherwise), and the ring writes —
+        distinct edges, order-free — land as one vectorized scatter."""
+        if self.marker_mode == "split":
+            def body(k, s):
+                e = self._edge_table[node, k]
+                return lax.cond(e >= 0,
+                                lambda s: self._push_marker(s, e, sid),
+                                lambda s: s, s)
+            return lax.fori_loop(0, self.topo.d, body, s)
+        row = self._edge_table[node]                        # [D], -1 padded
+        valid = row >= 0
+        if self.delay.position_streams:
+            # draw k's stream position = its rank among the row's real
+            # links (same positions sequential draws would consume)
+            off = jnp.cumsum(valid.astype(_i32)) - valid
+            rts_k = jnp.asarray(self.delay.block_receive_times(
+                s.delay_state, s.time, off), _i32)
+            dstate = self.delay.advance_draws(
+                s.delay_state, jnp.sum(valid.astype(_i32)))
+        else:
+            # order-dependent sampler (GoExact): the draws stay a
+            # sequential scan, but it carries only the sampler state —
+            # the [E, C] ring writes move out of the loop
+            def step(dstate, e):
+                def real(d):
+                    rt, d2 = self.delay.draw(d, s.time)
+                    return d2, jnp.asarray(rt, _i32)
+
+                return lax.cond(e >= 0, real,
+                                lambda d: (d, _i32(0)), dstate)
+
+            dstate, rts_k = lax.scan(step, s.delay_state, row)
+        s = s._replace(delay_state=dstate)
+        tgt = jnp.where(valid, row, self.topo.e)            # pads drop
+        active = jnp.zeros(self.topo.e, jnp.bool_).at[tgt].set(
+            True, mode="drop")
+        rt_e = jnp.zeros(self.topo.e, _i32).at[tgt].set(rts_k, mode="drop")
+        return self._append_rows(s, active, rt_e, True,
+                                 jnp.asarray(sid, _i32))
 
     def _finalize_check(self, s: DenseState, sid, node) -> DenseState:
         """finalizeSnapshot + NotifyCompletedSnapshot when no links remain
@@ -534,7 +674,7 @@ class TickKernel:
         """Pop edge e's head and dispatch (HandlePacket, node.go:140-146)."""
         C = self.cfg.queue_capacity
         slot = s.q_head[e]
-        is_marker = s.q_marker[e, slot]
+        is_marker = meta_marker(s.q_meta[e, slot])
         data = s.q_data[e, slot]
         s = s._replace(q_head=s.q_head.at[e].set((slot + 1) % C),
                        q_len=s.q_len.at[e].add(-1))
@@ -552,7 +692,7 @@ class TickKernel:
             valid = edges >= 0
             safe = jnp.where(valid, edges, 0)
             heads = s.q_head[safe]
-            rts = s.q_rtime[safe, heads]
+            rts = meta_rtime(s.q_meta[safe, heads])
             elig = valid & (s.q_len[safe] > 0) & (rts <= s.time)
             found = jnp.any(elig)
             e = safe[jnp.argmax(elig)]                      # first in dest order
@@ -569,15 +709,12 @@ class TickKernel:
         formulations (fact 1 in _cascade_tick's docstring: selection is
         invariant over the fold, so every selected head can be popped up
         front with its payload captured). ``s.time`` must already be the
-        new tick's time. Returns (s, tok_pend, mk_pend, head_data)."""
+        new tick's time. Head reads are queue_engine-addressed
+        (_head_fields): O(E) gathers of the packed planes, or the legacy
+        O(E·C) one-hot reductions. Returns (s, tok_pend, mk_pend,
+        head_data)."""
         C = self.cfg.queue_capacity
-        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
-        head_hit = cc == s.q_head[:, None]                        # [E, C]
-        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1,
-                          dtype=_i32)
-        head_data = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
-                            dtype=_i32)
-        head_mk = jnp.any(head_hit & s.q_marker, axis=-1)
+        head_rt, head_mk, head_data = self._head_fields(s)
         elig = (s.q_len > 0) & (head_rt <= s.time)
         # first eligible edge per source in dest order (same O(E) prefix-
         # count formulation as _sync_tick; edges are per-source contiguous)
@@ -774,7 +911,6 @@ class TickKernel:
             self._seg_excl(jnp.take(mk_pend.astype(_i32), self._by_dst,
                                     axis=-1)),
             self._inv_by_dst, axis=-1)                             # [E]
-        cc = jnp.arange(C, dtype=_i32)[None, :]
         sid_rows = jnp.arange(S, dtype=_i32)[:, None]              # [S, 1]
 
         def cond(carry):
@@ -850,29 +986,14 @@ class TickKernel:
             )
             # re-broadcast (node.go:97-109): one marker per outbound edge
             # of each first-receipt destination, receive times served from
-            # the tick-start stream positions
+            # the tick-start stream positions, enqueued through the one
+            # batched append primitive (engine-addressed scatter)
             push_g = self._spread_src(wfirst_n)                    # [E]
             sid_g = jnp.take(wsid_n, self._edge_src, axis=-1)
             off_g = (jnp.take(wbase_n, self._edge_src, axis=-1)
                      + self._edge_ord_in_src)
             rt_g = self.delay.block_receive_times(dstate0, time, off_g)
-            pos_g = (s.q_head + s.q_len) % C
-            poh = (cc == pos_g[:, None]) & push_g[:, None]         # [E, C]
-            err = s.error | jnp.where(
-                jnp.any(push_g & (s.q_len >= C)),
-                ERR_QUEUE_OVERFLOW, 0).astype(_i32)
-            err = err | jnp.where(
-                jnp.any(push_g & (s.tok_pushed >= self._key_limit)),
-                ERR_VALUE_OVERFLOW, 0).astype(_i32)
-            s = s._replace(
-                q_data=jnp.where(poh, sid_g[:, None], s.q_data),
-                q_rtime=jnp.where(poh, jnp.asarray(rt_g, _i32)[:, None],
-                                  s.q_rtime),
-                q_marker=s.q_marker | poh,
-                q_len=s.q_len + push_g.astype(_i32),
-                tok_pushed=s.tok_pushed + push_g.astype(_i32),
-                error=err,
-            )
+            s = self._append_rows(s, push_g, rt_g, True, sid_g)
             # finalize after every receipt (R8, node.go:165-170)
             wm_sn = (sid_rows == wsid_n[None, :]) & wdst[None, :]  # [S, N]
             fire = wm_sn & s.has_local & (s.rem == 0) & ~s.done_local
@@ -922,19 +1043,17 @@ class TickKernel:
         S, M = self.cfg.max_snapshots, self.cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
-        cc = jnp.arange(C, dtype=_i32)[None, :]                   # [1, C]
         BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
 
-        # ---- channel fronts: token head via one-hot reads over the
-        # capacity axis; marker front = the pending marker with the
+        # ---- channel fronts: token head via queue_engine-addressed reads
+        # (_head_fields: O(E) packed-plane gathers, or the legacy [E, C]
+        # one-hot reductions); marker front = the pending marker with the
         # smallest merge key (DenseState docstring: key = tokens-pushed-
         # before x KEYMULT + marker ord, unique per edge, sorted by push
         # order). The marker front is the CHANNEL front iff every token
         # pushed before it has been popped; head-of-line blocking
         # (queue.go semantics) applies to that front's receive time.
-        head_hit = cc == s.q_head[:, None]                        # [E, C]
-        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
-        head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1, dtype=_i32)
+        head_rt, _, head_amt = self._head_fields(s)
         tok_live = s.q_len > 0
         tok_popped = s.tok_pushed - s.q_len                       # [E]
         m_key_live = jnp.where(s.m_pending, s.m_key, BIG)         # [S, E]
@@ -1129,35 +1248,16 @@ class TickKernel:
     def _bulk_push(self, s: DenseState, active, is_marker: bool, data
                    ) -> DenseState:
         """Vectorized enqueue: one message on every edge where ``active``,
-        written scatter-free via a one-hot select over the capacity axis
-        (dynamic-index scatters serialize badly on TPU; a dense [E, C] mask
-        is pure VPU work). Fast-path-only semantics: receive times are drawn
-        for every edge in one vectorized draw (inactive edges' draws are
+        written by the shared batched append primitive (_append_rows —
+        engine-addressed: O(E) scatters, or the legacy [E, C] one-hot
+        selects). Fast-path-only semantics: receive times are drawn for
+        every edge in one vectorized draw (inactive edges' draws are
         discarded), so the stream does NOT match sequential per-event sends
         under the Go-exact sampler — use _push/_inject_send for bit-exact
         runs."""
-        C = self.cfg.queue_capacity
         rts, dstate = self.delay.draw_many(s.delay_state, s.time, self.topo.e)
-        err = s.error | jnp.where(jnp.any(active & (s.q_len >= C)),
-                                  ERR_QUEUE_OVERFLOW, 0).astype(_i32)
-        pos = (s.q_head + s.q_len) % C
-        hit = active[:, None] & (jnp.arange(C, dtype=_i32)[None, :] == pos[:, None])
-        data = jnp.broadcast_to(jnp.asarray(data, _i32), active.shape)
-        err = err | jnp.where(jnp.any(active & (s.tok_pushed >= self._key_limit)),
-                              ERR_VALUE_OVERFLOW, 0).astype(_i32)
-        s = s._replace(
-            q_data=jnp.where(hit, data[:, None], s.q_data),
-            q_rtime=jnp.where(hit, jnp.asarray(rts, _i32)[:, None], s.q_rtime),
-            q_len=s.q_len + active.astype(_i32),
-            tok_pushed=s.tok_pushed + active.astype(_i32),
-            delay_state=dstate,
-            error=err,
-        )
-        if self.marker_mode == "split" and not is_marker:
-            # split-mode rings never hold markers: q_marker stays all-False,
-            # so skip its [E, C] read+write entirely
-            return s
-        return s._replace(q_marker=jnp.where(hit, is_marker, s.q_marker))
+        s = s._replace(delay_state=dstate)
+        return self._append_rows(s, active, rts, is_marker, data)
 
     def _bulk_send(self, s: DenseState, amounts) -> DenseState:
         """Vectorized token injection: one message per edge with amounts[e]>0

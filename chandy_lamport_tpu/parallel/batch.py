@@ -146,7 +146,8 @@ class BatchedRunner:
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
                  check_every: int = 0, exact_impl: str = "cascade",
-                 auto_layouts: bool = False, megatick: int = 1):
+                 auto_layouts: bool = False, megatick: int = 1,
+                 queue_engine: str = "auto"):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -191,7 +192,15 @@ class BatchedRunner:
         dispatch-bound single-instance path. The quiescence fast-forward
         (drained stretches in O(1)) applies at every K, including 1.
         Semantics-preserving knob either way; bench --megatick exposes
-        it for the on-device A/B."""
+        it for the on-device A/B.
+
+        queue_engine: ring-queue addressing (ops/tick.TickKernel): "gather"
+        = O(E) head gathers + append scatters over the packed planes,
+        "mask" = the O(E·C) one-hot formulation, "auto" (default) =
+        backend-resolved (ops/tick.resolve_queue_engine: gather on TPU,
+        mask on CPU where XLA serializes the scatters). Bit-identical
+        results; ``self.queue_engine`` holds the resolved engine, and
+        bench --queue-engine exposes the A/B and stamps the row."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -210,7 +219,9 @@ class BatchedRunner:
         self.kernel = TickKernel(
             self.topo, self.config, self.delay,
             marker_mode="split" if scheduler == "sync" else "ring",
-            exact_impl=exact_impl, megatick=megatick)
+            exact_impl=exact_impl, megatick=megatick,
+            queue_engine=queue_engine)
+        self.queue_engine = self.kernel.queue_engine
         if scheduler == "exact":
             self._tick_fn = self.kernel._exact_tick
             self._drain_fn = self.kernel._drain_and_flush

@@ -50,12 +50,16 @@ from chandy_lamport_tpu.core.state import (
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
     F32_EXACT_LIMIT,
+    RTIME_PACK_LIMIT,
     DenseTopology,
+    meta_rtime,
+    pack_meta,
 )
 from chandy_lamport_tpu.ops.tick import (
     log_append,
     merge_key_limit,
     merge_keymult,
+    resolve_queue_engine,
     window_update,
 )
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
@@ -113,7 +117,9 @@ class ShardedState(NamedTuple):
     time: Any        # i32 [] (replicated)
     tokens: Any      # i32 [P, Nl]
     q_data: Any      # i32 [P, Em, C]
-    q_rtime: Any     # i32 [P, Em, C]
+    q_meta: Any      # i32 [P, Em, C]  packed rtime << 1 | marker
+    #                  (state.pack_meta; the marker bit is never set here —
+    #                  the sharded runner is split-only)
     q_head: Any      # i32 [P, Em]
     q_len: Any       # i32 [P, Em]
     tok_pushed: Any  # i32 [P, Em]
@@ -196,7 +202,7 @@ class GraphShardedRunner:
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
                  max_delay: int = 5, fixed_delay: Optional[int] = None,
-                 check_every: int = 0):
+                 check_every: int = 0, queue_engine: str = "auto"):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -205,7 +211,14 @@ class GraphShardedRunner:
         every K storm phases and after drain (one psum of the per-shard
         balances + in-flight ring tokens vs the initial total), setting
         the replicated sticky ERR_CONSERVATION bit — the sharded twin of
-        BatchedRunner's sanitizer."""
+        BatchedRunner's sanitizer.
+
+        queue_engine: ring-queue addressing, the sharded twin of
+        TickKernel's knob (ops/tick.py): "gather" = O(Em) head gathers +
+        append scatters over the packed planes, "mask" = the [Em, C]
+        one-hot formulation, "auto" (default) = backend-resolved
+        (ops/tick.resolve_queue_engine). All ring state is shard-local,
+        so the choice changes no collective."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.mesh = mesh
@@ -215,6 +228,7 @@ class GraphShardedRunner:
         if check_every < 0:
             raise ValueError("check_every must be >= 0 (0 = off)")
         self.check_every = int(check_every)
+        self.queue_engine = resolve_queue_engine(queue_engine)
         self.max_delay = fixed_delay if fixed_delay is not None else max_delay
         self.fixed_delay = fixed_delay
         if self.config.max_delay != self.max_delay:
@@ -249,7 +263,7 @@ class GraphShardedRunner:
             a_in_c=spec_sharded, a_src_c=spec_sharded, src_first=spec_sharded,
             in_degree=spec_rep)
         state_specs = ShardedState(
-            time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_rtime=spec_sharded,
+            time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_meta=spec_sharded,
             q_head=spec_sharded, q_len=spec_sharded,
             tok_pushed=spec_sharded, mk_cnt=spec_sharded,
             m_pending=spec_sharded, m_rtime=spec_sharded, m_key=spec_sharded,
@@ -264,7 +278,12 @@ class GraphShardedRunner:
 
         from functools import partial
 
-        smap = partial(jax.shard_map, mesh=mesh, check_vma=False)
+        # version-tolerant shard_map (utils/shardmap): jax.shard_map with
+        # check_vma on current releases, the jax.experimental spelling
+        # with check_rep on 0.4.x — one surface either way
+        from chandy_lamport_tpu.utils.shardmap import shard_map
+
+        smap = partial(shard_map, mesh=mesh)
         self._topo_specs = topo_specs
         self._run = jax.jit(smap(
             self._run_storm_body,
@@ -293,7 +312,7 @@ class GraphShardedRunner:
             time=np.int32(0),
             tokens=tokens,
             q_data=np.zeros((p, em, c), np.int32),
-            q_rtime=np.zeros((p, em, c), np.int32),
+            q_meta=np.zeros((p, em, c), np.int32),
             q_head=np.zeros((p, em), np.int32),
             q_len=np.zeros((p, em), np.int32),
             tok_pushed=np.zeros((p, em), np.int32),
@@ -382,6 +401,60 @@ class GraphShardedRunner:
 
     # -- kernel pieces (run inside shard_map; shapes are per-shard) --------
 
+    def _head_fields(self, s: ShardedState):
+        """Every local ring head's (rtime, amount) by ``queue_engine``:
+        one [Em] gather per packed plane, or the legacy [Em, C] one-hot
+        reductions (TickKernel._head_fields' shard-local twin; the split
+        ring's marker bit is always 0 so only rtime/amount are decoded)."""
+        if self.queue_engine == "gather":
+            head_meta = jnp.take_along_axis(
+                s.q_meta, s.q_head[:, None], axis=-1)[..., 0]
+            head_amt = jnp.take_along_axis(
+                s.q_data, s.q_head[:, None], axis=-1)[..., 0]
+        else:
+            cc = jnp.arange(self.config.queue_capacity, dtype=_i32)[None, :]
+            head_hit = cc == s.q_head[:, None]                  # [Em, C]
+            head_meta = jnp.sum(jnp.where(head_hit, s.q_meta, 0),
+                                axis=-1, dtype=_i32)
+            head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0),
+                               axis=-1, dtype=_i32)
+        return meta_rtime(head_meta), head_amt
+
+    def _append_active(self, s: ShardedState, active, rt_e, data_e):
+        """Batched shard-local ring append (TickKernel._append_rows' twin,
+        tokens only — the packed marker bit stays 0): one vectorized
+        ``.at[edge, pos]`` scatter per plane under the gather engine
+        (inactive rows aim at column C and drop), the legacy [Em, C]
+        one-hot selects under "mask". Returns (state, local error bits) —
+        the caller psums the bits so every shard's SPMD schedule stays
+        aligned. Pad edges are never active (their amounts are 0)."""
+        C = self.config.queue_capacity
+        rt_e = jnp.asarray(rt_e, _i32)
+        data_e = jnp.asarray(data_e, _i32)
+        err = (jnp.any(active & (s.tok_pushed >= self._key_limit))
+               | jnp.any(active & (rt_e >= RTIME_PACK_LIMIT))
+               ).astype(_i32) * ERR_VALUE_OVERFLOW
+        pos = (s.q_head + s.q_len) % C
+        meta_e = pack_meta(rt_e, False)
+        if self.queue_engine == "gather":
+            rows = jnp.arange(active.shape[-1], dtype=_i32)
+            tgt = jnp.where(active, pos, C)   # inactive -> OOB, dropped
+            q_meta = s.q_meta.at[rows, tgt].set(meta_e, mode="drop",
+                                                unique_indices=True)
+            q_data = s.q_data.at[rows, tgt].set(data_e, mode="drop",
+                                                unique_indices=True)
+        else:
+            hit = active[:, None] & (jnp.arange(C, dtype=_i32)[None, :]
+                                     == pos[:, None])           # [Em, C]
+            q_meta = jnp.where(hit, meta_e[:, None], s.q_meta)
+            q_data = jnp.where(hit, data_e[:, None], s.q_data)
+        return s._replace(
+            q_meta=q_meta,
+            q_data=q_data,
+            q_len=s.q_len + active.astype(_i32),
+            tok_pushed=s.tok_pushed + active.astype(_i32),
+        ), err
+
     def _draw_many(self, key, time, shape):
         if self.fixed_delay is not None:
             return jnp.full(shape, time + self.fixed_delay, _i32), key
@@ -453,20 +526,9 @@ class GraphShardedRunner:
                         ).astype(_i32) * ERR_VALUE_OVERFLOW)
         s = s._replace(tokens=tokens, error=s.error | self._por(err_local))
         rts, key = self._draw_many(s.delay_key, s.time, active.shape)
-        C = self.config.queue_capacity
-        cc = jnp.arange(C, dtype=_i32)[None, :]
-        pos = (s.q_head + s.q_len) % C
-        hit = active[:, None] & (cc == pos[:, None])
-        key_ovf = jnp.any(active & (s.tok_pushed >= self._key_limit)
-                          ).astype(_i32) * ERR_VALUE_OVERFLOW
-        return s._replace(
-            q_data=jnp.where(hit, amounts[:, None], s.q_data),
-            q_rtime=jnp.where(hit, rts[:, None], s.q_rtime),
-            q_len=s.q_len + active.astype(_i32),
-            tok_pushed=s.tok_pushed + active.astype(_i32),
-            delay_key=key,
-            error=s.error | self._por(key_ovf),
-        )
+        s, err = self._append_active(s._replace(delay_key=key),
+                                     active, rts, amounts)
+        return s._replace(error=s.error | self._por(err))
 
     def _bulk_snapshots(self, s: ShardedState, st: ShardedTopology,
                         init_mask_n) -> ShardedState:
@@ -503,6 +565,7 @@ class GraphShardedRunner:
             | (active & (amt_i >= F32_EXACT_LIMIT)).astype(_i32)
             * ERR_VALUE_OVERFLOW)
         rt, key = self._draw_many(s.delay_key, s.time, ())
+        rt = jnp.asarray(rt, _i32)
         pos = (s.q_head[e] + s.q_len[e]) % C
 
         def sel(old, new):
@@ -511,14 +574,15 @@ class GraphShardedRunner:
         return s._replace(
             tokens=s.tokens.at[src_l].add(-amt_i * a),
             q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i)),
-            q_rtime=s.q_rtime.at[e, pos].set(
-                sel(s.q_rtime[e, pos], jnp.asarray(rt, _i32))),
+            q_meta=s.q_meta.at[e, pos].set(
+                sel(s.q_meta[e, pos], pack_meta(rt, False))),
             q_len=s.q_len.at[e].add(a),
             tok_pushed=s.tok_pushed.at[e].add(a),
             delay_key=key,
             error=s.error | self._por(
                 err_local
-                | (a & (s.tok_pushed[e] >= self._key_limit)).astype(_i32)
+                | (a & ((s.tok_pushed[e] >= self._key_limit)
+                        | (rt >= RTIME_PACK_LIMIT))).astype(_i32)
                 * ERR_VALUE_OVERFLOW),
         )
 
@@ -528,18 +592,16 @@ class GraphShardedRunner:
         C, S, M = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
-        cc = jnp.arange(C, dtype=_i32)[None, :]
 
         # channel fronts under the split representation (mirrors
-        # TickKernel._sync_tick): token head via one-hot reads, marker
-        # front = min-seq pending plane entry; the merged FIFO's front is
-        # whichever has the smaller sequence number. All per-edge state is
-        # local to this shard — no collective in the front selection.
+        # TickKernel._sync_tick): token head via queue_engine-addressed
+        # reads (_head_fields: O(Em) packed-plane gathers, or the legacy
+        # one-hot reductions), marker front = min-seq pending plane entry;
+        # the merged FIFO's front is whichever has the smaller sequence
+        # number. All per-edge state is local to this shard — no
+        # collective in the front selection.
         BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
-        head_hit = cc == s.q_head[:, None]
-        head_rt = jnp.sum(jnp.where(head_hit, s.q_rtime, 0), axis=-1, dtype=_i32)
-        head_amt = jnp.sum(jnp.where(head_hit, s.q_data, 0), axis=-1,
-                           dtype=_i32)
+        head_rt, head_amt = self._head_fields(s)
         tok_live = s.q_len > 0
         tok_popped = s.tok_pushed - s.q_len
         m_key_live = jnp.where(s.m_pending, s.m_key, BIG)        # [S, Em]
@@ -794,7 +856,9 @@ class GraphShardedRunner:
             state_specs = jax.tree_util.tree_map(
                 lambda sp: self._batched_spec(sp, data_axis),
                 self._state_specs)
-            smap = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+            from chandy_lamport_tpu.utils.shardmap import shard_map
+
+            smap = partial(shard_map, mesh=self.mesh)
             self._run_batched_cache[data_axis] = jax.jit(smap(
                 self._run_storm_body_batched,
                 in_specs=(state_specs, self._topo_specs,
@@ -852,12 +916,11 @@ class GraphShardedRunner:
         return DenseState(
             time=np.asarray(h.time),
             tokens=nodes(h.tokens),
-            # the sharded runner is split-only: the ring never holds markers,
-            # so the DenseState view's ring marker plane is all-False
-            q_marker=np.zeros((self.topo.e, self.config.queue_capacity),
-                              np.bool_),
+            # the sharded runner is split-only: the ring never holds
+            # markers, so the packed q_meta marker bits are all 0 — the
+            # reassembled plane carries straight over
+            q_meta=edges(h.q_meta),
             q_data=edges(h.q_data),
-            q_rtime=edges(h.q_rtime),
             q_head=edges(h.q_head),
             q_len=edges(h.q_len),
             tok_pushed=edges(h.tok_pushed),

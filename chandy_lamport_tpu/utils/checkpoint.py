@@ -30,7 +30,11 @@ from chandy_lamport_tpu.core.state import DenseState
 #       min_prot/log_amt/rec_start/rec_end) + round-4 three-word hash-delay
 #       state; old checkpoints get the unsupported-version error instead of
 #       a misleading leaf-count mismatch
-_FORMAT_VERSION = 2
+#   3 — PR-2 packed ring slots: the q_marker/q_data/q_rtime planes became
+#       q_meta (rtime << 1 | is_marker) + q_data (core/state.py "Packed
+#       ring slots"); a version-2 checkpoint's separate marker/rtime leaves
+#       cannot be reinterpreted, so they error here rather than misdecode
+_FORMAT_VERSION = 3
 
 
 def save_state(path: str, state: DenseState, meta: dict | None = None) -> None:

@@ -36,9 +36,11 @@ def _occupied(state: DenseState, cfg: SimConfig):
 
 
 def in_flight_tokens(state: DenseState, cfg: SimConfig) -> jnp.ndarray:
-    """Total tokens inside channels (non-marker live slots), all instances."""
+    """Total tokens inside channels (non-marker live slots, read from the
+    packed q_meta marker bit), all instances."""
     occ = _occupied(state, cfg)
-    return jnp.sum(jnp.where(occ & ~state.q_marker, state.q_data, 0))
+    return jnp.sum(jnp.where(occ & ((state.q_meta & 1) == 0),
+                             state.q_data, 0))
 
 
 def total_tokens(state: DenseState, cfg: SimConfig) -> jnp.ndarray:
@@ -91,10 +93,13 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 9·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + (10+2·win)·E)
+    footprint = 8·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + (10+2·win)·E)
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16),
     win = itemsize of SimConfig.window_dtype (4 default, 2 for uint16),
-    and L = cfg.max_recorded (shared per-edge log slots).
+    and L = cfg.max_recorded (shared per-edge log slots). The 8·E·C term
+    is the two packed int32 ring planes (q_meta = rtime<<1|marker, q_data;
+    core/state.py "Packed ring slots" — the former separate bool marker
+    plane is folded into q_meta).
 
     Dominant terms at bench shapes are the [S, E] recording/window/marker
     planes and the per-edge log ``log_amt[L, E]`` — size S and L to the
@@ -106,8 +111,8 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
     rec = np.dtype(cfg.record_dtype).itemsize
     win = np.dtype(cfg.window_dtype).itemsize
-    # q_* rings (marker/data/rtime) + head/len/tok_pushed/mk_cnt
-    queues = e * c * (1 + 4 + 4) + e * (4 + 4 + 4 + 4)
+    # q_* rings (packed meta + data) + head/len/tok_pushed/mk_cnt
+    queues = e * c * (4 + 4) + e * (4 + 4 + 4 + 4)
     nodes = 4 * n                                       # tokens
     # per-edge recording log: rec_cnt/min_prot + log_amt[L, E]
     rec_log = e * (4 + 4) + rec * m * e

@@ -1,0 +1,64 @@
+"""Version-tolerant access to jax's shard_map (the utils/layouts.py pattern).
+
+The graph-sharded runner (parallel/graphshard.GraphShardedRunner) was
+written against the current spelling ``jax.shard_map(..., check_vma=...)``;
+older jax releases (the 0.4.x line this image ships) expose the same
+transform as ``jax.experimental.shard_map.shard_map`` with the replication
+check named ``check_rep``. This module maps both spellings onto one surface
+so the sharded runners construct — and the graphshard/multihost tier-1
+suites RUN — on either, instead of dying on an AttributeError at
+``jax.shard_map`` (the 22 pre-seed failures).
+
+Surface:
+  HAVE_SHARD_MAP   whether any shard_map implementation is importable
+  SHARD_MAP_SPELLING  where it was found ("jax.shard_map" /
+                   "jax.experimental.shard_map.shard_map" / None)
+  shard_map(f, mesh, in_specs, out_specs, check=False)
+                   the transform with the replication/VMA check knob
+                   normalized to ``check`` (False matches the runners'
+                   check_vma=False / check_rep=False intent)
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # current spelling: jax.shard_map(..., check_vma=...)
+    _impl = jax.shard_map  # type: ignore[attr-defined]
+    SHARD_MAP_SPELLING = "jax.shard_map"
+except AttributeError:
+    try:  # jax 0.4.x spelling: experimental module, check_rep kwarg
+        from jax.experimental.shard_map import shard_map as _impl  # type: ignore
+
+        SHARD_MAP_SPELLING = "jax.experimental.shard_map.shard_map"
+    except ImportError:  # no shard_map at all: sharded runners unavailable
+        _impl = None
+        SHARD_MAP_SPELLING = None
+
+HAVE_SHARD_MAP = _impl is not None
+
+if HAVE_SHARD_MAP:
+    try:
+        _params = inspect.signature(_impl).parameters
+    except (TypeError, ValueError):  # C-level / wrapped callable: assume new
+        _params = {"check_vma": None}
+    # the replication checker has been renamed across releases; resolve the
+    # kwarg once at import so call sites never branch on jax versions
+    _CHECK_KW = next((kw for kw in ("check_vma", "check_rep")
+                      if kw in _params), None)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    """The shard_map transform under either spelling. ``check`` feeds the
+    replication/VMA checker (``check_vma`` on current jax, ``check_rep``
+    on 0.4.x); the runners pass False — their bodies use collectives whose
+    replication the checker cannot always prove."""
+    if not HAVE_SHARD_MAP:
+        raise ImportError(
+            "no shard_map implementation in this jax build (looked for "
+            "jax.shard_map and jax.experimental.shard_map.shard_map); "
+            "the graph-sharded/multihost runners cannot be used")
+    kw = {_CHECK_KW: check} if _CHECK_KW else {}
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

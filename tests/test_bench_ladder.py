@@ -130,6 +130,22 @@ def test_assume_tpu_double_signal_death_goes_cpu(monkeypatch, capsys):
     assert row["platform"] == "cpu"
 
 
+@pytest.mark.parametrize("engine", ["gather", "mask"])
+def test_worker_row_round_trips_queue_engine(engine, capsys):
+    """A real (tiny, CPU) --worker measurement: the JSON row must carry
+    the queue_engine that actually ran, so BENCH_*.json rows attribute
+    wins to the right ring addressing (PR-2 satellite)."""
+    rc = bench.main(["--worker", "--nodes", "16", "--batch", "2",
+                     "--phases", "3", "--snapshots", "2", "--repeats", "1",
+                     "--queue-engine", engine])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["metric"] == "node_ticks_per_sec_per_chip"
+    assert row["queue_engine"] == engine
+    assert row["value"] > 0
+
+
 def test_dead_probe_path_tries_tpu_blind_then_cpu(monkeypatch, capsys):
     # every probe hung: one blind full-size TPU attempt before the cpu
     # fallback (the round-3 official number was lost to skipping this)

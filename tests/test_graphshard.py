@@ -81,10 +81,10 @@ def test_sharded_matches_unsharded_fixed_delay(shards):
     counts = [sum(1 for i in range(gs.topo.e)
                   if gs.topo.edge_src[i] // gs.nl == p)
               for p in range(shards)]
-    # split representation: rings never hold markers (the sharded state has
-    # no marker plane at all; the dense one must be all-False)
-    assert not np.asarray(ref_final.q_marker).any()
-    for name in ("q_data", "q_rtime", "q_head", "q_len",
+    # split representation: rings never hold markers, so no packed q_meta
+    # slot ever carries the marker bit (core/state.py "Packed ring slots")
+    assert not (np.asarray(ref_final.q_meta) & 1).any()
+    for name in ("q_data", "q_meta", "q_head", "q_len",
                  "tok_pushed", "mk_cnt"):
         parts = [getattr(final, name)[p][:counts[p]] for p in range(shards)]
         got = np.concatenate(parts, axis=0)

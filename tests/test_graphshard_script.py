@@ -58,7 +58,7 @@ def test_script_sharded_matches_unsharded(top, events, shards):
     got = gs.gather_dense(gs.run_script(gs.init_state(), script))
 
     assert int(got.error) == 0
-    for name in ("time", "tokens", "q_marker", "q_data", "q_rtime", "q_head",
+    for name in ("time", "tokens", "q_meta", "q_data", "q_head",
                  "q_len", "tok_pushed", "mk_cnt", "m_pending", "m_rtime",
                  "m_key", "next_sid", "started", "has_local", "frozen", "rem",
                  "done_local", "recording", "rec_cnt", "min_prot",

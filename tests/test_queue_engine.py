@@ -1,0 +1,193 @@
+"""Differential coverage for the ring-queue addressing engines (PR 2).
+
+The gather engine (ops/tick.TickKernel queue_engine="gather") reads ring
+heads with O(E) ``take_along_axis`` gathers and appends with O(E)
+``.at[edge, pos]`` scatters over the packed ``q_meta``/``q_data`` planes;
+"mask" is the pre-PR-2 O(E·C) one-hot formulation, kept as the oracle.
+The two must be BIT-IDENTICAL — same ring planes, same error bits, same
+sampler stream — on every exact formulation (fold, cascade, wave), and
+in the three ring regimes that distinguish the addressings: wraparound
+(head+len crossing C), full capacity, and a marker at the head.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import (
+    DenseTopology,
+    init_state,
+    pack_meta,
+)
+from chandy_lamport_tpu.models.workloads import (
+    erdos_renyi,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, HashJaxDelay
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+
+IMPLS = ("fold", "cascade", "wave")
+
+
+def _kernel_pair(impl, cfg, spec=None, delay=None):
+    topo = DenseTopology(spec or erdos_renyi(8, 2.5, seed=7, tokens=50))
+    delay = delay or FixedJaxDelay(2)
+    return topo, delay, [
+        TickKernel(topo, cfg, delay, marker_mode="ring", exact_impl=impl,
+                   queue_engine=eng) for eng in ("gather", "mask")]
+
+
+def _craft(state, topo, cfg, case):
+    """Hand-built ring regimes. time stays 0; the tick advances it to 1,
+    so rtime=1 heads are exactly-now eligible."""
+    e, C = topo.e, cfg.queue_capacity
+    q_meta = np.zeros((e, C), np.int32)
+    q_data = np.zeros((e, C), np.int32)
+    if case == "wrap":
+        # head+len crosses C: slots C-1 and 0 occupied
+        head = np.full(e, C - 1, np.int32)
+        length = np.full(e, 2, np.int32)
+        q_meta[:, C - 1] = pack_meta(1, False)
+        q_data[:, C - 1] = 5
+        q_meta[:, 0] = pack_meta(3, False)
+        q_data[:, 0] = 7
+    elif case == "full":
+        # every slot occupied, head mid-ring
+        head = np.full(e, 1, np.int32)
+        length = np.full(e, C, np.int32)
+        for k in range(C):
+            pos = (1 + k) % C
+            q_meta[:, pos] = pack_meta(1 + k, False)
+            q_data[:, pos] = 10 + k
+    else:  # marker_head
+        # marker at the head (sid 0), token right behind, wrapped head;
+        # the first-receipt broadcast then APPENDS through the engines
+        head = np.full(e, C - 1, np.int32)
+        length = np.full(e, 2, np.int32)
+        q_meta[:, C - 1] = pack_meta(1, True)
+        q_data[:, C - 1] = 0
+        q_meta[:, 0] = pack_meta(2, False)
+        q_data[:, 0] = 3
+    return state._replace(q_meta=q_meta, q_data=q_data, q_head=head,
+                          q_len=length,
+                          tok_pushed=np.asarray(length).copy())
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("case", ["wrap", "full", "marker_head"])
+def test_crafted_ring_regimes(impl, case):
+    cfg = SimConfig(max_snapshots=4, queue_capacity=4, max_recorded=16)
+    topo, delay, kernels = _kernel_pair(impl, cfg)
+    finals = []
+    for k in kernels:
+        s = _craft(init_state(topo, cfg, delay.init_state()), topo, cfg,
+                   case)
+        s = k.tick(s)            # engine-addressed select/pop (+ appends)
+        s = k.tick(s)            # second tick: pops across the wrap point
+        finals.append(jax.device_get(s))
+    assert dense_state_mismatches(*finals) == []
+    if case == "full" and impl != "fold":
+        # popped-up-front semantics: a full ring with no same-tick append
+        # must NOT flag overflow under either engine
+        assert int(finals[0].error) == 0
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_append_rows_partial_active(impl):
+    """The batched append primitive directly: a partially-active row on a
+    wrapped ring must land the same slots, lengths, and overflow bits
+    under both addressings (inactive rows must drop, not write)."""
+    cfg = SimConfig(max_snapshots=4, queue_capacity=4, max_recorded=16)
+    topo, delay, kernels = _kernel_pair(impl, cfg)
+    active = np.arange(topo.e) % 2 == 0
+    rt = np.full(topo.e, 9, np.int32)
+    data = np.arange(topo.e, dtype=np.int32) + 100
+    outs = []
+    for k in kernels:
+        s = _craft(init_state(topo, cfg, delay.init_state()), topo, cfg,
+                   "wrap")
+        outs.append(jax.device_get(
+            jax.jit(k._append_rows)(s, active, rt, False, data)))
+    assert dense_state_mismatches(*outs) == []
+    np.testing.assert_array_equal(outs[0].q_len[active], 3)
+    np.testing.assert_array_equal(outs[0].q_len[~active], 2)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_append_rows_overflow_parity(impl):
+    """Appending onto a FULL ring flags ERR_QUEUE_OVERFLOW identically
+    (and clobbers the same slot) under both engines."""
+    cfg = SimConfig(max_snapshots=4, queue_capacity=4, max_recorded=16)
+    topo, delay, kernels = _kernel_pair(impl, cfg)
+    active = np.ones(topo.e, bool)
+    outs = []
+    for k in kernels:
+        s = _craft(init_state(topo, cfg, delay.init_state()), topo, cfg,
+                   "full")
+        outs.append(jax.device_get(jax.jit(k._append_rows)(
+            s, active, np.full(topo.e, 9, np.int32), False,
+            np.int32(1))))
+    assert dense_state_mismatches(*outs) == []
+    assert int(outs[0].error) != 0
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_storm_gather_vs_mask(impl):
+    """End-to-end batched storms: the full protocol (injections, marker
+    broadcasts, drain — every push/pop path) bit-identical across
+    engines, per exact formulation."""
+    spec = erdos_renyi(16, 2.5, seed=11, tokens=60)
+    cfg = SimConfig(max_snapshots=4, queue_capacity=24, max_recorded=48)
+    finals = []
+    for eng in ("gather", "mask"):
+        r = BatchedRunner(spec, cfg, HashJaxDelay(seed=31), batch=4,
+                          scheduler="exact", exact_impl=impl,
+                          queue_engine=eng)
+        prog = storm_program(
+            r.topo, phases=5, amount=2,
+            snapshot_phases=staggered_snapshots(r.topo, 3))
+        finals.append(jax.device_get(r.run_storm(r.init_batch(), prog)))
+    assert int(np.max(finals[0].error)) == 0
+    assert dense_state_mismatches(*finals) == []
+
+
+def test_auto_engine_resolution():
+    """queue_engine="auto" resolves per backend (gather where O(E) HBM
+    traffic wins, mask where XLA serializes scatters), parameterized so
+    the TPU decision is pinned from the CPU mesh — the count_dtype
+    pattern."""
+    from chandy_lamport_tpu.ops.tick import resolve_queue_engine
+
+    assert resolve_queue_engine("auto", backend="tpu") == "gather"
+    assert resolve_queue_engine("auto", backend="cpu") == "mask"
+    assert resolve_queue_engine("gather", backend="cpu") == "gather"
+    assert resolve_queue_engine("mask", backend="tpu") == "mask"
+    with pytest.raises(ValueError):
+        resolve_queue_engine("bogus")
+    # a live kernel always carries a RESOLVED engine
+    cfg = SimConfig(max_snapshots=4, queue_capacity=4, max_recorded=16)
+    _, _, kernels = _kernel_pair("cascade", cfg)
+    topo = kernels[0].topo
+    auto_k = TickKernel(topo, cfg, FixedJaxDelay(2), marker_mode="ring")
+    assert auto_k.queue_engine in ("gather", "mask")
+
+
+def test_sync_scheduler_gather_vs_mask():
+    """The split-representation sync tick reads token heads through the
+    same engine-addressed primitive — pin it too."""
+    spec = erdos_renyi(16, 2.5, seed=13, tokens=60)
+    cfg = SimConfig(max_snapshots=4, queue_capacity=24, max_recorded=48)
+    finals = []
+    for eng in ("gather", "mask"):
+        r = BatchedRunner(spec, cfg, HashJaxDelay(seed=37), batch=4,
+                          scheduler="sync", queue_engine=eng)
+        prog = storm_program(
+            r.topo, phases=5, amount=2,
+            snapshot_phases=staggered_snapshots(r.topo, 3))
+        finals.append(jax.device_get(r.run_storm(r.init_batch(), prog)))
+    assert int(np.max(finals[0].error)) == 0
+    assert dense_state_mismatches(*finals) == []

@@ -74,7 +74,7 @@ def test_storm_matches_per_event_injection_fixed_delay():
     for i in range(2):
         np.testing.assert_array_equal(storm_final.tokens[i], single.tokens)
         np.testing.assert_array_equal(storm_final.q_len[i], single.q_len)
-        np.testing.assert_array_equal(storm_final.q_rtime[i], single.q_rtime)
+        np.testing.assert_array_equal(storm_final.q_meta[i], single.q_meta)
 
 
 import pytest

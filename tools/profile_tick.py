@@ -83,6 +83,13 @@ def main() -> None:
                         "{0,2,1}<->{0,1,2} boundary copies — the in-scan "
                         "regime); 'default' = row-major boundaries (the "
                         "round-3 profile's 22%% copy lines) for A/B")
+    p.add_argument("--queue-engine", choices=["auto", "gather", "mask"],
+                   default="auto",
+                   help="ring-queue addressing for the profiled kernel "
+                        "(ops/tick.TickKernel; auto = backend-resolved); "
+                        "the 'queue ops' section below times BOTH engines "
+                        "regardless, so the O(E·C)->O(E) claim is "
+                        "measured, not asserted")
     p.add_argument("--snapshots", type=int, default=8)
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="same knob as bench --delay")
@@ -116,7 +123,8 @@ def main() -> None:
                            cfg, make_fast_delay(args.delay, 17),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
-                           megatick=args.megatick)
+                           megatick=args.megatick,
+                           queue_engine=args.queue_engine)
     print(f"N={runner.topo.n} E={runner.topo.e} B={args.batch} "
           f"scheduler={args.scheduler} mode={runner.kernel._mode}",
           file=sys.stderr)
@@ -167,6 +175,58 @@ def main() -> None:
     print(f"per-tick (untraced): {per_tick * 1e3:.2f} ms -> "
           f"{args.batch * runner.topo.n / per_tick / 1e6:.1f}M node-ticks/s",
           file=sys.stderr)
+
+    # ---- queue ops A/B: the PR-2 claim, measured ------------------------
+    # Per-primitive wall clock of the three ring-queue operations under
+    # BOTH addressings (ops/tick.TickKernel queue_engine): "gather" = O(E)
+    # take_along_axis head reads + .at[edge, pos] append scatters over the
+    # packed planes; "mask" = the legacy [E, C] one-hot reductions/selects
+    # whose HBM traffic scales with queue CAPACITY. Same state, same
+    # shapes — only the addressing differs.
+    from chandy_lamport_tpu.ops.tick import TickKernel
+
+    reps = max(args.ticks, 10)
+    qtimings = {}
+    for engine in ("gather", "mask"):
+        k_eng = (runner.kernel if engine == runner.kernel.queue_engine
+                 else TickKernel(runner.topo, runner.config, runner.delay,
+                                 marker_mode=runner.kernel.marker_mode,
+                                 exact_impl=args.exact_impl,
+                                 megatick=args.megatick,
+                                 queue_engine=engine))
+
+        def head_select(t, k=k_eng):
+            rt, mk, data = k._head_fields(t)
+            return rt + data + mk          # keep all three reads live
+
+        def select_pop(t, k=k_eng):
+            t = t._replace(time=t.time + 1)
+            return k._select_and_pop(t)[0]
+
+        def append_all(t, k=k_eng):
+            active = jax.numpy.ones(k.topo.e, bool)
+            return k._append_rows(t, active, t.time + 1, False,
+                                  jax.numpy.int32(1))
+
+        for name, fn in (("head-select", head_select),
+                         ("pop", select_pop), ("append", append_all)):
+            jfn = jax.jit(jax.vmap(fn))
+            st = runner.init_batch_device()
+            out = jfn(st)                  # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jfn(st)
+            jax.block_until_ready(out)
+            qtimings[(engine, name)] = (time.perf_counter() - t0) / reps
+    print("queue ops (per call, both addressings):", file=sys.stderr)
+    print(f"  {'op':<12} {'gather ms':>10} {'mask ms':>10} {'speedup':>8}",
+          file=sys.stderr)
+    for name in ("head-select", "pop", "append"):
+        g = qtimings[("gather", name)]
+        m = qtimings[("mask", name)]
+        print(f"  {name:<12} {g * 1e3:10.3f} {m * 1e3:10.3f} "
+              f"{m / g:7.2f}x", file=sys.stderr)
 
     if args.scheduler == "exact":
         # per-stage wall-clock of the fused exact path: how much of a
@@ -223,8 +283,15 @@ def main() -> None:
     jax.block_until_ready(s)
     jax.profiler.stop_trace()
 
+    try:
+        rows = top_ops(args.out, args.top)
+    except Exception as exc:  # xprof not installed / conversion failed:
+        # the wall-clock sections above already printed — keep the trace
+        print(f"hlo_stats unavailable ({type(exc).__name__}: {exc}); "
+              f"raw trace kept under {args.out}", file=sys.stderr)
+        return
     print(f"{'self ms':>9} {'%':>6} {'x':>5}  cat/bound  op")
-    for self_us, pct, occ, cat, bound, expr in top_ops(args.out, args.top):
+    for self_us, pct, occ, cat, bound, expr in rows:
         print(f"{self_us / 1e3:9.2f} {pct:6.2f} {occ:5}  {cat}/{bound}  {expr}")
 
 
