@@ -28,12 +28,16 @@ from chandy_lamport_tpu.utils.fixtures import (
 
 def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
                  config: Optional[SimConfig] = None, trace: bool = False,
-                 exact_impl: str = "cascade"):
+                 exact_impl: str = "cascade", faults=None):
     if name == "parity":
         if exact_impl != "cascade":
             raise ValueError(
                 "exact_impl is a jax-backend knob (the parity oracle has "
                 "one reference-literal implementation); use backend='jax'")
+        if faults is not None:
+            raise ValueError(
+                "the fault adversary is a jax-backend feature (the parity "
+                "oracle is the uninjured reference); use backend='jax'")
         from chandy_lamport_tpu.core.parity import ParitySim
 
         sim = ParitySim(delay_model,
@@ -53,21 +57,24 @@ def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
         from chandy_lamport_tpu.core.dense import DenseSim
 
         return DenseSim(topology, delay_model, config or SimConfig(),
-                        exact_impl=exact_impl)
+                        exact_impl=exact_impl, faults=faults)
     raise ValueError(f"unknown backend {name!r} (expected 'parity' or 'jax')")
 
 
 def run_events(backend_name: str, topology: TopologySpec, events: List[Event],
                delay_model: DelayModel, config: Optional[SimConfig] = None,
-               trace: bool = False, exact_impl: str = "cascade"):
+               trace: bool = False, exact_impl: str = "cascade", faults=None):
     """Run a parsed event script to completion; returns (snapshots, sim).
 
     ``exact_impl`` (jax backend only): "cascade" (default), "wave", or
     "fold" — the bit-identical formulations of the reference scheduler
     (ops/tick.TickKernel docstring; "wave" requires a position-addressable
-    delay sampler such as FixedDelay's or HashJaxDelay's streams)."""
+    delay sampler such as FixedDelay's or HashJaxDelay's streams).
+    ``faults`` (jax backend only): a models/faults.JaxFaults adversary —
+    the zero-rate engine is the golden-parity differential oracle
+    (tests/test_faults.py)."""
     sim = make_backend(backend_name, topology, delay_model, config,
-                       trace=trace, exact_impl=exact_impl)
+                       trace=trace, exact_impl=exact_impl, faults=faults)
     if backend_name == "parity":
         from chandy_lamport_tpu.core.parity import run_events as _run
 
@@ -80,11 +87,11 @@ def run_events_file(top_path: str, events_path: str, backend: str = "parity",
                     delay_model: Optional[DelayModel] = None,
                     config: Optional[SimConfig] = None,
                     trace: bool = False, exact_impl: str = "cascade",
-                    ) -> Tuple[List[GlobalSnapshot], object]:
+                    faults=None) -> Tuple[List[GlobalSnapshot], object]:
     """Parse fixture files and run them — the ``runTest`` equivalent
     (snapshot_test.go:11-44) minus the assertions."""
     topology = read_topology_file(top_path)
     events = read_events_file(events_path)
     dm = delay_model if delay_model is not None else GoExactDelay(seed)
     return run_events(backend, topology, events, dm, config, trace=trace,
-                      exact_impl=exact_impl)
+                      exact_impl=exact_impl, faults=faults)

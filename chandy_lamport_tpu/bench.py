@@ -338,6 +338,7 @@ def run_worker(args) -> int:
     from chandy_lamport_tpu.core.state import (
         ERR_QUEUE_OVERFLOW,
         ERR_RECORD_OVERFLOW,
+        decode_error_bits,
         decode_errors,
     )
     from chandy_lamport_tpu.utils.metrics import instance_footprint_bytes
@@ -425,8 +426,8 @@ def run_worker(args) -> int:
         bits = summary["error_bits"]
         if not bits:
             break
-        for msg in decode_errors(bits):
-            log(f"error bit: {msg}")
+        for name, msg in zip(decode_error_bits(bits), decode_errors(bits)):
+            log(f"error bit {name}: {msg}")
         recoverable = ERR_QUEUE_OVERFLOW | ERR_RECORD_OVERFLOW
         if (bits & ~recoverable) or cap_try == 3:
             log("ERROR: lanes with error flags — results invalid")
@@ -497,6 +498,10 @@ def run_worker(args) -> int:
         "max_recorded": cfg.max_recorded,
         "delay": args.delay,
         "layouts": runner.layouts_effective,
+        # a valid row ran with zero error bits, and says so in names, not
+        # raw ints (core/state.decode_error_bits)
+        "error_bits": summary["error_bits"],
+        "errors_decoded": summary["errors_decoded"],
     }
     result.update(mem)
     if dev.platform != "tpu":
@@ -561,7 +566,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
     import numpy as np
     from jax.sharding import Mesh
 
-    from chandy_lamport_tpu.core.state import decode_errors
+    from chandy_lamport_tpu.core.state import decode_error_bits, decode_errors
     from chandy_lamport_tpu.models.workloads import (
         staggered_snapshots,
         storm_program,
@@ -603,8 +608,8 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         del final  # double-residency guard (same as the batched path)
         if not bits:
             break
-        for msg in decode_errors(bits):
-            log(f"error bit: {msg}")
+        for name, msg in zip(decode_error_bits(bits), decode_errors(bits)):
+            log(f"error bit {name}: {msg}")
         if (bits & ~recoverable) or cap_try == 2:
             # a non-capacity bit is a real failure — doubling capacities
             # would just recompile the giant-instance kernel to fail again
@@ -661,6 +666,8 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "record_dtype": cfg.record_dtype,
         "max_recorded": cfg.max_recorded,
         "per_tick_ms": round(times[-1] / ticks_seen[-1] * 1e3, 3),
+        "error_bits": bits,
+        "errors_decoded": decode_error_bits(bits),
     }
     result.update(mem)
     if dev.platform != "tpu":

@@ -102,9 +102,13 @@ def _cmd_test(args) -> int:
 
 
 def _cmd_storm(args) -> int:
+    import numpy as np
+
     import jax
 
+    from chandy_lamport_tpu.core.state import decode_error_bits
     from chandy_lamport_tpu.models.workloads import (
+        StormProgram,
         erdos_renyi,
         ring_topology,
         scale_free,
@@ -113,6 +117,7 @@ def _cmd_storm(args) -> int:
     )
     from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.checkpoint import load_state, save_state
     from chandy_lamport_tpu.utils.metrics import (
         conservation_delta,
         progress_counters,
@@ -131,31 +136,106 @@ def _cmd_storm(args) -> int:
         split_markers=args.scheduler == "sync",
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
+    faults = None
+    if any((args.fault_drop, args.fault_dup, args.fault_jitter,
+            args.fault_crash)):
+        from chandy_lamport_tpu.models.faults import JaxFaults
+
+        faults = JaxFaults(
+            args.fault_seed if args.fault_seed is not None else args.seed,
+            drop_rate=args.fault_drop, dup_rate=args.fault_dup,
+            jitter_rate=args.fault_jitter, crash_rate=args.fault_crash,
+            crash_mode=args.crash_mode, crash_len=args.crash_len,
+            crash_period=args.crash_period)
+    # an armed adversary quarantines by default: an injured lane freezes
+    # with its decoded bits surfaced instead of poisoning the aggregates
+    quarantine = args.quarantine or faults is not None
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            check_every=args.check_every,
-                           megatick=args.megatick)
+                           megatick=args.megatick, faults=faults,
+                           quarantine=quarantine)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
         snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
                                             max_phases=args.phases))
-    final = runner.run_storm(runner.init_batch(), prog)
+    meta_base = {"nodes": runner.topo.n, "batch": args.batch,
+                 "scheduler": args.scheduler, "phases": args.phases,
+                 "delay": args.delay, "seed": args.seed}
+    if faults is not None:
+        meta_base["faults"] = faults.describe()
+
+    state = runner.init_batch()
+    start_phase = 0
+    if args.resume_from:
+        # the `like` template is a fresh state from the SAME flags — shape/
+        # treedef validation rejects a checkpoint from a different run shape
+        state, meta = load_state(args.resume_from, state)
+        start_phase = int(meta.get("next_phase", 0))
+        print(f"resumed from {args.resume_from} at phase {start_phase}",
+              file=sys.stderr)
+    if args.checkpoint_every:
+        if not args.checkpoint:
+            print("--checkpoint-every needs --checkpoint PATH (the file "
+                  "the periodic snapshots land in)", file=sys.stderr)
+            return 2
+        # chunked execution: K phases per dispatch, atomic checkpoint after
+        # each chunk. Bit-identical to the single dispatch (same ticks,
+        # same state-carried streams); a kill between chunks resumes via
+        # --resume-from to the same final state.
+        k = args.checkpoint_every
+        for chunk, lo in enumerate(range(start_phase, args.phases, k)):
+            hi = min(lo + k, args.phases)
+            sub = StormProgram(np.asarray(prog.amounts)[lo:hi],
+                               np.asarray(prog.snap)[lo:hi])
+            state = runner.run_storm(state, sub, drain=False)
+            jax.block_until_ready(state)
+            save_state(args.checkpoint, state,
+                       meta={**meta_base, "next_phase": hi})
+            if args.kill_after_chunk is not None \
+                    and chunk == args.kill_after_chunk:
+                # deterministic mid-run "preemption" for the resume tests:
+                # die right after a checkpoint landed, before the drain
+                print(json.dumps({"killed_after_phase": hi,
+                                  "checkpoint": args.checkpoint}))
+                return 17
+        final = runner.drain(state)
+    else:
+        sub = (prog if not start_phase
+               else StormProgram(np.asarray(prog.amounts)[start_phase:],
+                                 np.asarray(prog.snap)[start_phase:]))
+        final = runner.run_storm(state, sub)
     jax.block_until_ready(final)
     counters = {k: int(v) for k, v in progress_counters(
         final, cfg, runner.topo.n).items()}
+    counters["errors_decoded"] = decode_error_bits(counters["error_bits"])
     expected = int(runner.topo.tokens0.sum()) * args.batch
     counters["conservation_delta"] = int(
         conservation_delta(final, cfg, expected))
+    errs = np.asarray(jax.device_get(final.error))
+    if faults is not None:
+        summary = BatchedRunner.summarize(final)
+        counters["fault_events"] = summary["fault_events"]
+        counters["fault_skew"] = summary["fault_skew"]
+        counters["quarantined_lanes"] = int((errs != 0).sum())
+        # per-lane decode for the injured lanes (first 16), so a crashed
+        # lane's fate is readable straight off the JSON row
+        counters["lane_errors"] = {
+            int(i): decode_error_bits(int(errs[i]))
+            for i in np.flatnonzero(errs)[:16]}
     if args.checkpoint:
-        from chandy_lamport_tpu.utils.checkpoint import save_state
-
         save_state(args.checkpoint, final,
-                   meta={"nodes": runner.topo.n, "batch": args.batch,
-                         "scheduler": args.scheduler})
+                   meta={**meta_base, "next_phase": args.phases,
+                         "drained": True})
         counters["checkpoint"] = args.checkpoint
     print(json.dumps(counters))
-    return 0 if counters["error_bits"] == 0 else 1
+    if counters["error_bits"] == 0:
+        return 0
+    # an armed adversary EXPECTS casualties: the run succeeds when every
+    # injured lane was quarantined (frozen + decoded above) rather than
+    # silently poisoning the aggregates
+    return 0 if (faults is not None and quarantine) else 1
 
 
 def _cmd_bench(args) -> int:
@@ -239,7 +319,49 @@ def main(argv=None) -> int:
                     default="hash",
                     help="fast-path delay sampler (same default as bench "
                          "--delay)")
-    ps.add_argument("--checkpoint", help="save final state to this .npz")
+    ps.add_argument("--checkpoint", help="save final state to this .npz "
+                                         "(atomic tmp-then-replace write)")
+    ps.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                    help="checkpoint to --checkpoint after every K phases "
+                         "(chunked dispatch, bit-identical to the single "
+                         "dispatch); a killed run resumes via --resume-from "
+                         "to a bit-identical final state")
+    ps.add_argument("--resume-from", metavar="PATH",
+                    help="resume a storm from a checkpoint written by "
+                         "--checkpoint-every (pass the SAME storm flags; "
+                         "shape/structure mismatches are rejected with a "
+                         "CheckpointError)")
+    ps.add_argument("--fault-drop", type=float, default=0.0, metavar="R",
+                    help="fault adversary (models/faults.py): per-(edge, "
+                         "tick) token-drop probability")
+    ps.add_argument("--fault-dup", type=float, default=0.0, metavar="R",
+                    help="per-(edge, tick) token-duplicate probability")
+    ps.add_argument("--fault-jitter", type=float, default=0.0, metavar="R",
+                    help="per-(edge, tick) extra-delay jitter (front stall) "
+                         "probability")
+    ps.add_argument("--fault-crash", type=float, default=0.0, metavar="R",
+                    help="per-(node, window) crash probability "
+                         "(--crash-mode picks pause/lossy semantics)")
+    ps.add_argument("--fault-seed", type=int, default=None,
+                    help="adversary stream seed (default: --seed)")
+    ps.add_argument("--crash-mode", choices=["pause", "lossy"],
+                    default="pause",
+                    help="crash semantics: 'pause' = preemption (memory "
+                         "survives, resume is the recovery); 'lossy' = "
+                         "restart restores from the last completed "
+                         "Chandy-Lamport snapshot, or quarantines with "
+                         "ERR_FAULT_UNRECOVERED when none exists")
+    ps.add_argument("--crash-len", type=int, default=2,
+                    help="crash window length in ticks")
+    ps.add_argument("--crash-period", type=int, default=32,
+                    help="crash window cadence in ticks")
+    ps.add_argument("--quarantine", action="store_true",
+                    help="freeze a lane the moment its error bits fire "
+                         "(auto-enabled whenever a fault rate is set)")
+    ps.add_argument("--kill-after-chunk", type=int, default=None,
+                    help=argparse.SUPPRESS)  # resume-test hook: exit 17
+    #                                          right after that chunk's
+    #                                          checkpoint lands
     ps.set_defaults(fn=_cmd_storm)
 
     pb = sub.add_parser("bench", help="node-ticks/sec benchmark")

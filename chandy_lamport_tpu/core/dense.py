@@ -52,14 +52,17 @@ class DenseSim:
                  delay_model: Union[DelayModel, JaxDelay],
                  config: Optional[SimConfig] = None,
                  exact_impl: str = "cascade", megatick: int = 8,
-                 queue_engine: str = "auto"):
+                 queue_engine: str = "auto", faults=None):
         """``megatick``: K-tick fusion depth for ``tick N`` events and the
         drain loop (ops/tick.TickKernel docstring); semantics-preserving,
         1 restores the reference-literal one-iteration-per-tick loops (the
         oracle configuration the megatick differentials compare against).
         ``queue_engine``: ring-queue addressing (TickKernel docstring) —
         "gather" O(E) gathers/scatters, "mask" one-hot, or "auto"
-        (default, backend-resolved); bit-identical results."""
+        (default, backend-resolved); bit-identical results.
+        ``faults``: models/faults.JaxFaults or None — arm the deterministic
+        fault adversary (TickKernel docstring); None compiles the hooks
+        away entirely."""
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -71,9 +74,10 @@ class DenseSim:
                 self.config, max_delay=self.delay.max_delay)
         self.kernel = TickKernel(self.topo, self.config, self.delay,
                                  exact_impl=exact_impl, megatick=megatick,
-                                 queue_engine=queue_engine)
+                                 queue_engine=queue_engine, faults=faults)
         self.state: DenseState = init_state(
-            self.topo, self.config, self.delay.init_state())
+            self.topo, self.config, self.delay.init_state(),
+            fault_key=int(faults.init_state()) if faults is not None else 0)
         self._host_cache: Optional[DenseState] = None
         # host mirror of state.next_sid (ids are allocated sequentially,
         # sim.go:107-108) so collection knows which slots this run started

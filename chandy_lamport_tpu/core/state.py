@@ -58,6 +58,13 @@ ERR_TOKEN_UNDERFLOW = 8
 ERR_TICK_LIMIT = 16
 ERR_VALUE_OVERFLOW = 32
 ERR_CONSERVATION = 64
+ERR_FAULT_UNRECOVERED = 128
+
+# fault_counts[4] event-class indices (models/faults.py adversary): message
+# drops, message duplicates, per-(edge, tick) extra-delay jitter stalls, and
+# node crash restarts — per-lane evidence that an injected fault class
+# actually fired (tools/chaos_smoke.py asserts on these)
+FC_DROP, FC_DUP, FC_JITTER, FC_CRASH = 0, 1, 2, 3
 
 # largest token amount the sync scheduler's f32 incidence matmuls carry
 # exactly; amounts at or beyond this fire ERR_VALUE_OVERFLOW instead of
@@ -112,6 +119,25 @@ ERROR_NAMES = {
                       "BatchedRunner check_every — the reference's "
                       "checkTokens invariant, test_common.go:298-328, "
                       "evaluated inside the jit run)",
+    ERR_FAULT_UNRECOVERED: "a lossy node crash restarted with no completed "
+                           "Chandy-Lamport snapshot to restore from "
+                           "(models/faults.py crash_mode='lossy': the "
+                           "node's un-snapshotted balance is gone; "
+                           "quarantine the lane or schedule snapshots "
+                           "ahead of the crash windows)",
+}
+
+# short symbol-style names for user-facing output (CLI counters, bench JSON
+# rows, soak logs) — the long ERROR_NAMES messages stay the diagnostic text
+ERROR_BIT_NAMES = {
+    ERR_QUEUE_OVERFLOW: "ERR_QUEUE_OVERFLOW",
+    ERR_SNAPSHOT_OVERFLOW: "ERR_SNAPSHOT_OVERFLOW",
+    ERR_RECORD_OVERFLOW: "ERR_RECORD_OVERFLOW",
+    ERR_TOKEN_UNDERFLOW: "ERR_TOKEN_UNDERFLOW",
+    ERR_TICK_LIMIT: "ERR_TICK_LIMIT",
+    ERR_VALUE_OVERFLOW: "ERR_VALUE_OVERFLOW",
+    ERR_CONSERVATION: "ERR_CONSERVATION",
+    ERR_FAULT_UNRECOVERED: "ERR_FAULT_UNRECOVERED",
 }
 
 
@@ -244,11 +270,24 @@ class DenseState(NamedTuple):
     rec_end: Any       # i32 [S, E]  rec_cnt at recording stop
     completed: Any     # i32 [S]      nodes finalized for this snapshot
     delay_state: Any   # sampler-specific pytree
+    # fault-adversary state (models/faults.py; checkpoint format v4 leaves):
+    # the adversary itself is stateless — a counter hash over (key, time,
+    # index) — so its whole carry is the per-lane stream key plus the books
+    # it keeps so conservation stays checkable under injected faults
+    fault_key: Any     # u32 [] per-lane adversary stream key (0 = disarmed)
+    fault_skew: Any    # i32 [] token delta the adversary injected
+    #                    (duplicates - drops + crash-restore deltas);
+    #                    conservation_delta subtracts it
+    fault_counts: Any  # i32 [4] fault events by class (FC_DROP/FC_DUP/
+    #                    FC_JITTER/FC_CRASH)
     error: Any         # i32 [] sticky bitmask
 
 
-def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseState:
-    """Fresh host-side (numpy) state; jnp conversion happens on first jit call."""
+def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any,
+               fault_key: int = 0) -> DenseState:
+    """Fresh host-side (numpy) state; jnp conversion happens on first jit
+    call. ``fault_key`` arms the fault adversary's per-lane stream
+    (models/faults.py; 0 = disarmed)."""
     n, e = topo.n, topo.e
     c, s, m = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
     i32, b = np.int32, np.bool_
@@ -278,6 +317,9 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any) -> DenseSt
         rec_end=np.zeros((s, e), np.dtype(cfg.window_dtype)),
         completed=np.zeros(s, i32),
         delay_state=delay_state,
+        fault_key=np.uint32(fault_key),
+        fault_skew=np.int32(0),
+        fault_counts=np.zeros(4, i32),
         error=np.int32(0),
     )
 
@@ -326,3 +368,11 @@ def decode_snapshot(topo: DenseTopology, host: DenseState, sid: int) -> GlobalSn
 
 def decode_errors(error_bits: int) -> List[str]:
     return [msg for bit, msg in ERROR_NAMES.items() if error_bits & bit]
+
+
+def decode_error_bits(mask: int) -> List[str]:
+    """Short ERR_* names for a bitmask — THE spelling for every place a raw
+    error int reaches user-facing output (cli counters, bench JSON rows,
+    soak logs); pair with decode_errors for the long diagnostic text."""
+    mask = int(mask)
+    return [name for bit, name in ERROR_BIT_NAMES.items() if mask & bit]

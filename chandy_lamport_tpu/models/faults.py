@@ -1,0 +1,199 @@
+"""Deterministic, replayable fault adversary for the dense/batched kernels.
+
+The simulator's reference semantics assume a kind world: reliable FIFO
+channels, no crashes (queue.go never loses a message; nodes never stop).
+Chandy-Lamport exists precisely because the real world is not kind — a
+completed snapshot IS a consistent recovery line — so this module makes the
+framework injure itself on purpose, the way JBotSim argues distributed
+algorithms must be tested under dynamic/lossy networks (PAPERS.md), with the
+replay discipline of the packet-level-simulation memoization work: every
+fault is a pure function of (stream key, tick, index), in the same
+stateless counter-hash style as ops/delay_jax.HashJaxDelay, so a faulted run
+can be reproduced bit-exactly from its seed alone — no fault log, no state
+beyond the per-lane key carried in ``DenseState.fault_key``.
+
+Fault classes (applied by ops/tick.TickKernel under a single per-tick mask;
+``faults=None`` at kernel construction compiles the hooks away entirely, so
+the fault-free path stays bit-identical to the uninstrumented kernels):
+
+  drop     a TOKEN selected for delivery is popped but lost (the amount is
+           neither credited nor recorded). Token-plane only: markers are the
+           protocol's control plane and are assumed reliable — dropping one
+           wedges the snapshot unrecoverably instead of testing recovery.
+  dup      a delivered token is ALSO re-enqueued on its edge with a fresh
+           receive time drawn from the FAULT stream (never the delay
+           sampler's, so the sampler stream is fault-invariant).
+  jitter   per-(edge, tick) delivery stall: the channel adds a tick of
+           latency to whatever is at its front (markers included). Under
+           FIFO head-of-line semantics this is exactly extra-delay jitter
+           applied at the head; the per-tick hash is independent, so a
+           stalled head is delivered with probability 1 eventually.
+  crash    per-node down windows. While down, a node receives nothing (its
+           inbound edges are ineligible; in-flight messages WAIT — channels
+           stay lossless). ``crash_mode``:
+             "pause"  preemption semantics — node memory survives, recovery
+                      is simply resuming (the TPU-preemption shape);
+             "lossy"  node memory is destroyed: at the restart tick the
+                      balance is restored from the last COMPLETED
+                      Chandy-Lamport snapshot's frozen value (the
+                      protocol's own artifact as the recovery line), or —
+                      with no completed snapshot to roll back to — zeroed
+                      with ERR_FAULT_UNRECOVERED raised for the lane.
+
+Bookkeeping: every injected token delta (dup - drop, crash-restore deltas)
+accumulates in ``DenseState.fault_skew``, so token conservation remains an
+exact in-run invariant under faults: utils.metrics.conservation_delta
+subtracts the skew, and a zero delta on a heavily-faulted lane is evidence
+the adversary's books balance (tools/chaos_smoke.py asserts exactly that).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from chandy_lamport_tpu.config import MAX_DELAY
+from chandy_lamport_tpu.ops.delay_jax import _lowbias32
+
+_u32 = jnp.uint32
+
+# per-class hash domains: every (class, tick, index) triple draws a distinct
+# word, so the classes' streams never alias each other
+_CLS_DROP, _CLS_DUP, _CLS_JITTER, _CLS_CRASH, _CLS_DUP_DELAY = range(1, 6)
+
+
+def _word(key, cls: int, time, idx):
+    """One u32 fault word for (key, class, time, idx) — pure, order-free
+    (the replay property), a handful of fused VPU ops (the hot-path
+    property). ``time``/``idx`` may be any integer arrays; broadcasting
+    follows jnp rules."""
+    t = _lowbias32(jnp.asarray(time).astype(_u32) * _u32(2654435769))
+    h = _lowbias32(jnp.asarray(idx).astype(_u32) ^ t)
+    return _lowbias32(h ^ key ^ _u32((cls * 0x9E3779B9) & 0xFFFFFFFF))
+
+
+class JaxFaults:
+    """Seeded fault program. Rates are static Python floats resolved at
+    trace time: a zero-rate class contributes all-False masks (the
+    instrumented-but-idle differential oracle), while ``faults=None`` at
+    TickKernel construction removes the instrumentation entirely.
+
+    ``crash_start`` switches the crash schedule from hashed periodic
+    windows to ONE deterministic window [crash_start, crash_start +
+    crash_len) — the targeting handle tests and the chaos smoke use to
+    place a crash exactly before/after a snapshot completes."""
+
+    def __init__(self, seed: int, *, drop_rate: float = 0.0,
+                 dup_rate: float = 0.0, jitter_rate: float = 0.0,
+                 crash_rate: float = 0.0, crash_len: int = 2,
+                 crash_period: int = 32, crash_mode: str = "pause",
+                 crash_start: int | None = None,
+                 max_delay: int = MAX_DELAY):
+        for name, r in (("drop_rate", drop_rate), ("dup_rate", dup_rate),
+                        ("jitter_rate", jitter_rate),
+                        ("crash_rate", crash_rate)):
+            if not 0.0 <= r <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {r}")
+        if crash_mode not in ("pause", "lossy"):
+            raise ValueError(f"unknown crash_mode {crash_mode!r}")
+        if crash_len < 1 or crash_period < 2 or crash_len >= crash_period:
+            raise ValueError(
+                "need 1 <= crash_len < crash_period (a window must end "
+                "before the next can start, or restarts never fire)")
+        self.seed = int(seed)
+        self.drop_rate = float(drop_rate)
+        self.dup_rate = float(dup_rate)
+        self.jitter_rate = float(jitter_rate)
+        self.crash_rate = float(crash_rate)
+        self.crash_len = int(crash_len)
+        self.crash_period = int(crash_period)
+        self.crash_mode = crash_mode
+        self.crash_start = None if crash_start is None else int(crash_start)
+        self.max_delay = int(max_delay)
+
+    @property
+    def crashes(self) -> bool:
+        """True when crash windows can ever fire — the gate that disables
+        the exact path's quiescence fast-forward (a lossy restart mutates
+        balances even on a drained lane, so empty rings no longer prove a
+        tick is a pure time increment)."""
+        return self.crash_rate > 0.0 or self.crash_start is not None
+
+    def describe(self) -> dict:
+        """JSON-able fault program (storm CLI / chaos smoke provenance)."""
+        return {"seed": self.seed, "drop": self.drop_rate,
+                "dup": self.dup_rate, "jitter": self.jitter_rate,
+                "crash": self.crash_rate, "crash_len": self.crash_len,
+                "crash_period": self.crash_period,
+                "crash_mode": self.crash_mode,
+                "crash_start": self.crash_start}
+
+    # -- stream keys (carried in DenseState.fault_key) ---------------------
+
+    def _base_key(self) -> int:
+        # host-side python mirror of _lowbias32 so keys are plain ints
+        x = (self.seed ^ 0x243F6A88) & 0xFFFFFFFF
+        for mul in (0x7FEB352D, 0x846CA68B):
+            x ^= x >> 16
+            x = (x * mul) & 0xFFFFFFFF
+        x ^= x >> 16
+        return x
+
+    def init_state(self):
+        """Single-instance stream key — nonzero (0 means disarmed)."""
+        return jnp.uint32(self._base_key() | 1)
+
+    def init_batch_state(self, batch: int):
+        """Per-lane keys: odd base + stride-2 ramp — injective mod 2^32 and
+        never zero, so no two lanes share a fault stream and every lane is
+        armed. Tests disarm chosen lanes by zeroing their key."""
+        base = self._base_key() | 1
+        return (_u32(base)
+                + _u32(2) * jnp.arange(batch, dtype=_u32))
+
+    # -- per-tick masks (under jit; key may be a scalar per vmap lane) -----
+
+    def _rate_mask(self, key, cls: int, rate: float, time, idx):
+        armed = key != _u32(0)
+        if rate <= 0.0:
+            return jnp.zeros(jnp.shape(idx), bool)
+        if rate >= 1.0:
+            return jnp.broadcast_to(armed, jnp.shape(idx))
+        thresh = _u32(min(int(rate * 2.0**32), 2**32 - 1))
+        return armed & (_word(key, cls, time, idx) < thresh)
+
+    def edge_masks(self, key, time, num_edges: int):
+        """This tick's per-edge fault program: (drop, dup, jitter) bool [E]
+        masks plus the dup re-enqueue delay words (raw u32 [E]; the kernel
+        folds them into its delay budget so duplicates always land inside
+        the drain flush window)."""
+        idx = jnp.arange(num_edges, dtype=_u32)
+        return (self._rate_mask(key, _CLS_DROP, self.drop_rate, time, idx),
+                self._rate_mask(key, _CLS_DUP, self.dup_rate, time, idx),
+                self._rate_mask(key, _CLS_JITTER, self.jitter_rate, time,
+                                idx),
+                _word(key, _CLS_DUP_DELAY, time, idx))
+
+    def down_nodes(self, key, time, num_nodes: int):
+        """[N] bool: nodes down (crashed) at ``time``. Deterministic-window
+        mode gates each node by the crash rate hashed once (window 0);
+        periodic mode re-draws each node per window, so crashes recur."""
+        idx = jnp.arange(num_nodes, dtype=_u32)
+        if not self.crashes:
+            return jnp.zeros(num_nodes, bool)
+        time = jnp.asarray(time, jnp.int32)
+        if self.crash_start is not None:
+            in_window = ((time >= self.crash_start)
+                         & (time < self.crash_start + self.crash_len))
+            gate = self._rate_mask(key, _CLS_CRASH,
+                                   self.crash_rate or 1.0, 0, idx)
+            return gate & in_window
+        window = time // self.crash_period
+        gate = self._rate_mask(key, _CLS_CRASH, self.crash_rate, window, idx)
+        return gate & ((time % self.crash_period) < self.crash_len)
+
+    def restarted(self, key, time, num_nodes: int):
+        """[N] bool: nodes whose crash window ended exactly at ``time``
+        (down at time-1, up now) — the restore point for lossy crashes."""
+        prev = self.down_nodes(key, time - 1, num_nodes)
+        now = self.down_nodes(key, time, num_nodes)
+        return prev & ~now & (jnp.asarray(time, jnp.int32) >= 1)

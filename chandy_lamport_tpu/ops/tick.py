@@ -38,6 +38,7 @@ from chandy_lamport_tpu.config import SimConfig
 from chandy_lamport_tpu.core.state import (
     DenseState,
     DenseTopology,
+    ERR_FAULT_UNRECOVERED,
     ERR_QUEUE_OVERFLOW,
     ERR_RECORD_OVERFLOW,
     ERR_SNAPSHOT_OVERFLOW,
@@ -45,6 +46,10 @@ from chandy_lamport_tpu.core.state import (
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
     F32_EXACT_LIMIT,
+    FC_CRASH,
+    FC_DROP,
+    FC_DUP,
+    FC_JITTER,
     RTIME_PACK_LIMIT,
     meta_marker,
     meta_rtime,
@@ -201,7 +206,8 @@ class TickKernel:
 
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
                  marker_mode: str = "ring", exact_impl: str = "cascade",
-                 megatick: int = 8, queue_engine: str = "auto"):
+                 megatick: int = 8, queue_engine: str = "auto",
+                 faults=None, quarantine: bool = False):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -242,9 +248,34 @@ class TickKernel:
         measured XLA:CPU scatter penalty); ``self.queue_engine`` holds
         the RESOLVED engine, and the non-default one stays available as
         the differential oracle and the tools/profile_tick.py
-        "queue ops" A/B."""
+        "queue ops" A/B.
+
+        faults (models/faults.JaxFaults or None) arms the deterministic
+        fault adversary: message drop/duplicate/extra-delay-jitter per
+        edge and crash/restart windows per node, every decision a
+        stateless counter hash of (DenseState.fault_key, tick, index) so
+        faulted runs replay bit-exactly. None (default) compiles the
+        hooks away — the fault-free path is the UNINSTRUMENTED kernel,
+        bit-identical to a build without this feature. A JaxFaults with
+        all rates zero keeps the instrumentation in the trace with
+        all-False masks (the differential oracle for the
+        masked-adversary overhead, tools/profile_tick.py "faults"
+        section). The reference-literal 'fold' formulation stays the
+        uninjured specification form and refuses a fault engine.
+
+        quarantine freezes a lane the moment its sticky error bits fire:
+        the drain/flush loops treat ``error != 0`` exactly like the
+        quiescence exit, so a poisoned lane stops ticking instead of
+        corrupting aggregate metrics (parallel/batch.py extends the
+        same gate to the storm phase scan)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
+        if (faults is not None and marker_mode == "ring"
+                and exact_impl == "fold"):
+            raise ValueError(
+                "exact_impl='fold' is the reference-literal specification "
+                "form and runs uninjured; use cascade/wave (or the sync "
+                "scheduler) for fault injection")
         queue_engine = resolve_queue_engine(queue_engine)
         if megatick < 1:
             raise ValueError(f"megatick must be >= 1, got {megatick}")
@@ -265,6 +296,8 @@ class TickKernel:
         self.exact_impl = exact_impl
         self.megatick = int(megatick)
         self.queue_engine = queue_engine
+        self.faults = faults
+        self.quarantine = bool(quarantine)
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -422,6 +455,87 @@ class TickKernel:
         if self._mode == "matmul":
             return (x_n.astype(self._cnt) @ self._A_out_c) > 0.5
         return jnp.take(x_n, self._edge_src, axis=-1)
+
+    # ---- fault adversary hooks (models/faults.py) ------------------------
+    # Only ever called under ``if self.faults is not None`` — a fault-free
+    # kernel traces zero adversary ops (the compiled-in, zero-cost-when-
+    # disabled contract). One shared set of hooks serves the sync tick and
+    # both vectorized exact formulations, so the fault semantics cannot
+    # drift between schedulers.
+
+    def _fault_edge_masks(self, s: DenseState):
+        """(drop, dup, jitter) bool [E] + dup receive times i32 [E] for the
+        CURRENT tick (s.time must already be incremented). Dup delays come
+        from the fault stream, folded into [1, max_delay], so the delay
+        sampler's stream is fault-invariant and every duplicate lands
+        inside the drain's max_delay+1 flush window."""
+        drop_e, dup_e, jit_e, dupw_e = self.faults.edge_masks(
+            s.fault_key, s.time, self.topo.e)
+        dup_rt = s.time + 1 + jnp.asarray(
+            dupw_e % jnp.uint32(max(self.cfg.max_delay, 1)), _i32)
+        return drop_e, dup_e, jit_e, dup_rt
+
+    def _fault_gate_elig(self, s: DenseState, elig, jit_e):
+        """Apply the delivery-side fault gates to an eligibility mask:
+        extra-delay jitter stalls the edge's front for this tick, and a
+        down (crashed) destination receives nothing — its in-flight
+        messages WAIT (channels stay lossless; recovery is the point, not
+        message loss). Returns (state with jitter events counted, elig)."""
+        blocked = elig & jit_e
+        down_n = self.faults.down_nodes(s.fault_key, s.time, self.topo.n)
+        dead = elig & self._spread_dst(down_n)
+        s = s._replace(fault_counts=s.fault_counts.at[FC_JITTER].add(
+            jnp.sum(blocked, dtype=_i32)))
+        return s, elig & ~blocked & ~dead
+
+    def _fault_split_tokens(self, s: DenseState, tok_e, amt_src, drop_e,
+                            dup_e):
+        """Split this tick's delivered-token mask by the adversary's drop/
+        duplicate program and settle the books: dropped tokens vanish
+        (popped, never credited or recorded), duplicated ones deliver AND
+        re-enqueue (the caller appends them after its pops). Returns
+        (state, surviving-token mask, dup mask)."""
+        dropped = tok_e & drop_e
+        duped = tok_e & dup_e & ~dropped   # a lost message cannot also fork
+        skew = (jnp.sum(jnp.where(duped, amt_src, 0), dtype=_i32)
+                - jnp.sum(jnp.where(dropped, amt_src, 0), dtype=_i32))
+        counts = s.fault_counts.at[FC_DROP].add(
+            jnp.sum(dropped, dtype=_i32)).at[FC_DUP].add(
+            jnp.sum(duped, dtype=_i32))
+        return (s._replace(fault_skew=s.fault_skew + skew,
+                           fault_counts=counts),
+                tok_e & ~dropped, duped)
+
+    def _fault_restart(self, s: DenseState) -> DenseState:
+        """Crash-window restarts at tick start (s.time already incremented).
+        'pause' mode only counts the event — node memory survived, resuming
+        IS the recovery. 'lossy' mode is snapshot-rollback recovery: the
+        restarting node's balance is restored from the last COMPLETED
+        Chandy-Lamport snapshot's frozen value (slot ids are allocation-
+        ordered, so the highest completed slot is the newest recovery
+        line); with no completed snapshot the balance is genuinely gone —
+        zeroed, ERR_FAULT_UNRECOVERED raised, quarantine's cue. Every
+        balance delta lands in fault_skew so conservation stays exact."""
+        f = self.faults
+        n = self.topo.n
+        rs_n = f.restarted(s.fault_key, s.time, n)
+        counts = s.fault_counts.at[FC_CRASH].add(jnp.sum(rs_n, dtype=_i32))
+        if f.crash_mode != "lossy":
+            return s._replace(fault_counts=counts)
+        S = self.cfg.max_snapshots
+        done = s.started & (s.completed >= n)
+        sid = jnp.max(jnp.where(done, jnp.arange(S, dtype=_i32), -1))
+        have = sid >= 0
+        frozen = s.frozen[jnp.clip(sid, 0, S - 1)]                 # [N]
+        restored = jnp.where(rs_n, jnp.where(have, frozen, 0), s.tokens)
+        err = jnp.where(jnp.any(rs_n) & ~have,
+                        ERR_FAULT_UNRECOVERED, 0).astype(_i32)
+        return s._replace(
+            tokens=restored,
+            fault_skew=s.fault_skew + jnp.sum(restored - s.tokens,
+                                              dtype=_i32),
+            fault_counts=counts,
+            error=s.error | err)
 
     # ---- queue primitives ------------------------------------------------
 
@@ -716,6 +830,11 @@ class TickKernel:
         C = self.cfg.queue_capacity
         head_rt, head_mk, head_data = self._head_fields(s)
         elig = (s.q_len > 0) & (head_rt <= s.time)
+        if self.faults is not None:
+            # delivery-side fault gates: jitter stalls the front, a down
+            # destination receives nothing (messages wait, lossless)
+            _, _, jit_e, _ = self._fault_edge_masks(s)
+            s, elig = self._fault_gate_elig(s, elig, jit_e)
         # first eligible edge per source in dest order (same O(E) prefix-
         # count formulation as _sync_tick; edges are per-source contiguous)
         elig_i = elig.astype(_i32)
@@ -793,7 +912,18 @@ class TickKernel:
         bit-identical. Size C with SimConfig.for_workload as always.
         """
         s = s._replace(time=s.time + 1)
+        dup_pend = dup_rt = None
+        if self.faults is not None:
+            s = self._fault_restart(s)
         s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
+        if self.faults is not None:
+            # drop/dup act on the popped token set; the marker fold below
+            # never sees a dropped token (it vanished on the wire), and
+            # duplicates re-enqueue after the fold so this tick's selection
+            # is untouched (their receive times are > time anyway)
+            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
+            s, tok_pend, dup_pend = self._fault_split_tokens(
+                s, tok_pend, head_data, drop_e, dup_e)
         amt_e = jnp.where(tok_pend, head_data, 0)
         sid_e = head_data                       # marker payload: snapshot id
         rows = self._rows_e
@@ -832,7 +962,13 @@ class TickKernel:
         log, cnt, err = log_append_masked(
             s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
             self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
-        return s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
+        s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
+        if self.faults is not None:
+            # duplicated tokens re-enter their channel at the tail, receive
+            # times from the fault stream (the delay sampler never sees a
+            # fault), overflow flagged by the shared append primitive
+            s = self._append_rows(s, dup_pend, dup_rt, False, head_data)
+        return s
 
     # ---- the wave tick: the cascade with cross-destination parallelism --
 
@@ -880,7 +1016,15 @@ class TickKernel:
         S, E = self.cfg.max_snapshots, self.topo.e
         s = s._replace(time=s.time + 1)
         time = s.time
+        dup_pend = dup_rt = None
+        if self.faults is not None:
+            s = self._fault_restart(s)
         s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
+        if self.faults is not None:
+            # same drop/dup discipline as the cascade (one shared hook set)
+            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
+            s, tok_pend, dup_pend = self._fault_split_tokens(
+                s, tok_pend, head_data, drop_e, dup_e)
         amt_e = jnp.where(tok_pend, head_data, 0)
         sid_e = head_data                       # marker payload: snapshot id
         rank_e = self._rows_e                   # fold rank == edge index
@@ -1010,7 +1154,10 @@ class TickKernel:
         log, cnt, err = log_append_masked(
             s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
             self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
-        return s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
+        s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
+        if self.faults is not None:
+            s = self._append_rows(s, dup_pend, dup_rt, False, head_data)
+        return s
 
     # ---- the synchronous tick (fast-path scheduler) ----------------------
 
@@ -1043,6 +1190,8 @@ class TickKernel:
         S, M = self.cfg.max_snapshots, self.cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
+        if self.faults is not None:
+            s = self._fault_restart(s)
         BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
 
         # ---- channel fronts: token head via queue_engine-addressed reads
@@ -1066,6 +1215,13 @@ class TickKernel:
             m_front_key // self._keymult <= tok_popped)           # [E]
         front_rt = jnp.where(front_is_marker, m_front_rt, head_rt)
         elig_e = (tok_live | front_is_marker) & (front_rt <= time)
+        dup_e_mask = dup_rt = None
+        if self.faults is not None:
+            # delivery-side gates first (jitter stalls the merged front —
+            # marker or token alike; a down destination receives nothing),
+            # then the drop/dup program on the tokens that do deliver
+            drop_e, dup_e_mask, jit_e, dup_rt = self._fault_edge_masks(s)
+            s, elig_e = self._fault_gate_elig(s, elig_e, jit_e)
         # at most one delivery per source: first eligible edge in dest
         # order, via an exclusive prefix count re-based at each source's
         # first edge (edges are per-source contiguous) — O(E)
@@ -1078,6 +1234,10 @@ class TickKernel:
             q_head=(s.q_head + tok_e) % C,
             q_len=s.q_len - tok_e.astype(_i32),
         )
+        dup_tok = None
+        if self.faults is not None:
+            s, tok_e, dup_tok = self._fault_split_tokens(
+                s, tok_e, head_amt, drop_e, dup_e_mask)
 
         # ---- token deliveries: credit via per-destination segment sums +
         # record into snapshots still recording at tick start (HandleToken,
@@ -1100,6 +1260,12 @@ class TickKernel:
             s.log_amt, s.rec_cnt, s.min_prot, s.recording,
             tok_e, amt_e, self._rec_dtype, self._rec_limit, M)
         s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err_bits)
+        if self.faults is not None:
+            # duplicated tokens re-enter their ring at the tail (receive
+            # times from the fault stream; tok_pushed advances, so this
+            # tick's marker merge keys order after the duplicate — any
+            # consistent order is legal, the reference never forks)
+            s = self._append_rows(s, dup_tok, dup_rt, False, head_amt)
 
         # ---- marker deliveries, all snapshot slots at once (HandleMarker,
         # node.go:149-171). The consumed marker per delivering edge is its
@@ -1157,7 +1323,14 @@ class TickKernel:
         marker, which needs a non-empty ring — which is what lets
         drained stretches fast-forward. Ring-mode only: the split
         representation's sync tick draws (S, E) delays every tick, so it
-        is never a pure time increment."""
+        is never a pure time increment.
+
+        A crash-capable fault adversary voids the proof: a lossy restart
+        mutates balances (and counts events) on a drained lane too, so
+        empty rings no longer make a tick the identity — quiescence is
+        statically False then and every tick runs for real."""
+        if self.faults is not None and self.faults.crashes:
+            return jnp.zeros(s.time.shape, bool)
         return ~jnp.any(s.q_len > 0, axis=-1)
 
     def _run_ticks(self, s: DenseState, n) -> DenseState:
@@ -1183,31 +1356,52 @@ class TickKernel:
         state — a measured 5.7x drain slowdown at the sf-256 B=64 CPU
         gauge — so the batched runner defaults to megatick=1
         (parallel/batch.py) while DenseSim keeps the fused default.
-        Bit-exact either way, by the _quiescent argument."""
+        Bit-exact either way, by the _quiescent argument.
+
+        Quarantine rides the same exits: an errored lane halts the loop
+        like quiescence, but is FROZEN — its clock does not fast-forward
+        (a quarantined lane's time records where it was poisoned)."""
         n = jnp.asarray(n, _i32)
         K = self.megatick
 
+        def halted(s):
+            if self.quarantine:
+                return self._quiescent(s) | (s.error != 0)
+            return self._quiescent(s)
+
+        def credit(s, i):
+            # drained lanes' remaining ticks are pure time increments;
+            # a quarantined lane stays frozen at its poisoning tick
+            rest = n - i
+            if self.quarantine:
+                rest = jnp.where(s.error != 0, 0, rest)
+            return s._replace(time=s.time + rest)
+
         def live(c):
-            return (c[1] < n) & ~self._quiescent(c[0])
+            return (c[1] < n) & ~halted(c[0])
 
         def plain(c):
             return self._exact_tick(c[0]), c[1] + 1
 
         if K <= 1:
             s, i = lax.while_loop(live, plain, (s, jnp.int32(0)))
-            return s._replace(time=s.time + (n - i))
+            return credit(s, i)
 
         rem = n % K
         s, i = lax.while_loop(
-            lambda c: (c[1] < rem) & ~self._quiescent(c[0]),
+            lambda c: (c[1] < rem) & ~halted(c[0]),
             plain, (s, jnp.int32(0)))
+
+        def bump(u):
+            if self.quarantine:
+                return u._replace(
+                    time=u.time + jnp.where(u.error != 0, 0, 1))
+            return u._replace(time=u.time + 1)
 
         def step(carry, _):
             t, quiet = carry
-            quiet = quiet | self._quiescent(t)
-            t = lax.cond(quiet,
-                         lambda u: u._replace(time=u.time + 1),
-                         self._exact_tick, t)
+            quiet = quiet | halted(t)
+            t = lax.cond(quiet, bump, self._exact_tick, t)
             return (t, quiet), None
 
         def mega(c):
@@ -1216,7 +1410,7 @@ class TickKernel:
             return t, c[1] + K
 
         s, i = lax.while_loop(live, mega, (s, i))
-        return s._replace(time=s.time + (n - i))
+        return credit(s, i)
 
     # ---- event injection (sim.go:58-68) ---------------------------------
 
@@ -1349,11 +1543,21 @@ class TickKernel:
         ``megatick`` K > 1 fuses K drain ticks per while iteration, each
         scan step re-checking the drain condition so exactly the same tick
         sequence executes (a step past completion is the identity — the
-        drain stops ticking, it does not time-advance)."""
+        drain stops ticking, it does not time-advance).
+
+        With ``quarantine`` on, ``error != 0`` halts a lane exactly like
+        the completion exit — a poisoned lane freezes (flush ticks
+        included) instead of grinding its corrupt state forward, and it is
+        NOT charged ERR_TICK_LIMIT for the ticks quarantine denied it."""
         limit = jnp.asarray(s.time + self.cfg.max_ticks, _i32)
 
-        def cond(s):
-            return self._pending(s) & (s.time < limit)
+        if self.quarantine:
+            def cond(s):
+                return (self._pending(s) & (s.time < limit)
+                        & (s.error == 0))
+        else:
+            def cond(s):
+                return self._pending(s) & (s.time < limit)
 
         if megatick > 1:
             def body(s):
@@ -1365,10 +1569,17 @@ class TickKernel:
         else:
             body = tick_fn
         s = lax.while_loop(cond, body, s)
+        budget_blown = self._pending(s)
+        if self.quarantine:
+            budget_blown = budget_blown & (s.error == 0)
         s = s._replace(error=s.error | jnp.where(
-            self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
+            budget_blown, ERR_TICK_LIMIT, 0).astype(_i32))
+        flush = tick_fn
+        if self.quarantine:
+            def flush(s):
+                return lax.cond(s.error == 0, tick_fn, lambda t: t, s)
         return lax.fori_loop(0, self.cfg.max_delay + 1,
-                             lambda _, s: tick_fn(s), s)
+                             lambda _, s: flush(s), s)
 
     def _drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._exact_tick,

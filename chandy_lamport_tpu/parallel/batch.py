@@ -147,7 +147,8 @@ class BatchedRunner:
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
                  check_every: int = 0, exact_impl: str = "cascade",
                  auto_layouts: bool = False, megatick: int = 1,
-                 queue_engine: str = "auto"):
+                 queue_engine: str = "auto", faults=None,
+                 quarantine: bool = False):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -200,7 +201,20 @@ class BatchedRunner:
         backend-resolved (ops/tick.resolve_queue_engine: gather on TPU,
         mask on CPU where XLA serializes the scatters). Bit-identical
         results; ``self.queue_engine`` holds the resolved engine, and
-        bench --queue-engine exposes the A/B and stamps the row."""
+        bench --queue-engine exposes the A/B and stamps the row.
+
+        faults: models/faults.JaxFaults — the deterministic fault
+        adversary, armed per lane through an injective nonzero
+        ``fault_key`` ramp (init_batch_state), so every lane suffers an
+        independent replayable fault stream (zero a lane's key to disarm
+        just that lane). None (default) compiles the hooks away.
+
+        quarantine: freeze a lane the moment its sticky error bits fire —
+        the storm phase scan, multi-tick stretches, drain and flush all
+        treat ``error != 0`` like the quiescence exit, so one poisoned
+        lane stops ticking (its time freezes at the poisoning tick)
+        instead of corrupting aggregate metrics; healthy lanes are
+        bit-unaffected. summarize() reports the decode."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -220,8 +234,11 @@ class BatchedRunner:
             self.topo, self.config, self.delay,
             marker_mode="split" if scheduler == "sync" else "ring",
             exact_impl=exact_impl, megatick=megatick,
-            queue_engine=queue_engine)
+            queue_engine=queue_engine, faults=faults,
+            quarantine=quarantine)
         self.queue_engine = self.kernel.queue_engine
+        self.faults = faults
+        self.quarantine = bool(quarantine)
         if scheduler == "exact":
             self._tick_fn = self.kernel._exact_tick
             self._drain_fn = self.kernel._drain_and_flush
@@ -277,6 +294,9 @@ class BatchedRunner:
         batched = jax.tree_util.tree_map(
             lambda x: np.broadcast_to(np.asarray(x), (self.batch,) + np.shape(x)).copy(),
             single._replace(delay_state=()))
+        if self.faults is not None:
+            batched = batched._replace(
+                fault_key=np.asarray(self.faults.init_batch_state(self.batch)))
         return batched._replace(delay_state=self._batched_delay_state())
 
     @property
@@ -353,6 +373,9 @@ class BatchedRunner:
                     # window yet" is encoded as int32 max (state.init_state)
                     min_prot=jnp.full_like(st.min_prot,
                                            jnp.iinfo(jnp.int32).max))
+                if self.faults is not None:
+                    st = st._replace(
+                        fault_key=self.faults.init_batch_state(self.batch))
                 return st._replace(delay_state=self._batched_delay_state())
 
             self._build_fn = build
@@ -379,6 +402,20 @@ class BatchedRunner:
 
     # -- execution ---------------------------------------------------------
 
+    def _quarantine_gate(self, phase_fn):
+        """Wrap a per-lane phase body so a lane with sticky error bits is
+        frozen for the whole phase — the scan-path extension of the
+        kernel's drain/flush quarantine exits. Identity when quarantine is
+        off (no cond in the trace)."""
+        if not self.quarantine:
+            return phase_fn
+
+        def gated(s, *xs):
+            return lax.cond(s.error == 0,
+                            lambda s: phase_fn(s, *xs), lambda s: s, s)
+
+        return gated
+
     def _apply_phase(self, s: DenseState, ops) -> DenseState:
         kind, arg0, arg1, do_tick = ops
 
@@ -389,12 +426,15 @@ class BatchedRunner:
                 lambda s: self.kernel._inject_snapshot(s, arg0[j]),
             ], s)
 
-        s = lax.fori_loop(0, kind.shape[0], body, s)
-        # do_tick is a COUNT (compile_events): the whole stretch runs under
-        # the fused multi-tick engine instead of one phase per tick
-        return lax.cond(do_tick != 0,
-                        lambda s: self._ticks_fn(s, do_tick),
-                        lambda s: s, s)
+        def run(s):
+            s = lax.fori_loop(0, kind.shape[0], body, s)
+            # do_tick is a COUNT (compile_events): the whole stretch runs
+            # under the fused multi-tick engine, one phase per stretch
+            return lax.cond(do_tick != 0,
+                            lambda s: self._ticks_fn(s, do_tick),
+                            lambda s: s, s)
+
+        return self._quarantine_gate(lambda s: run(s))(s)
 
     def _run_single_no_drain(self, s: DenseState, script: ScriptOps) -> DenseState:
         def phase(s, ops):
@@ -428,7 +468,9 @@ class BatchedRunner:
 
     def storm_phase(self, s: DenseState, amounts, snaps) -> DenseState:
         """One storm phase for one instance: bulk sends + scheduled snapshot
-        initiations + one tick. This is the framework's 'forward step'."""
+        initiations + one tick. This is the framework's 'forward step'.
+        Under quarantine the whole phase freezes on a poisoned lane
+        (_run_storm_phases wraps it in the per-lane gate)."""
         s = self.kernel._bulk_send(s, amounts)
         if self.scheduler == "sync":
             # dense initiation (ids allocated in node-index order == the
@@ -459,9 +501,10 @@ class BatchedRunner:
     def _run_storm_phases(self, s: DenseState, program) -> DenseState:
         amounts, snap = program
         k = self.check_every
+        gated_phase = self._quarantine_gate(self.storm_phase)
 
         def phase(s, xs):
-            s = self.storm_phase(s, xs[0], xs[1])
+            s = gated_phase(s, xs[0], xs[1])
             if k:
                 s = lax.cond((xs[2] + 1) % k == 0,
                              self._check_conservation, lambda s: s, s)
@@ -477,6 +520,22 @@ class BatchedRunner:
         s = self._run_storm_phases(s, program)
         s = self._drain_fn(s)
         return self._check_conservation(s) if self.check_every else s
+
+    def drain(self, state: DenseState) -> DenseState:
+        """Drain + flush every lane (and the final conservation check when
+        check_every is on) as its own dispatch — the tail step of a
+        chunked/checkpointed storm run (cli storm --checkpoint-every runs
+        phases in chunks with ``run_storm(..., drain=False)`` and finishes
+        here; bit-identical to the single-dispatch ``run_storm`` because
+        the per-tick math and the state-carried streams are unchanged)."""
+        if not hasattr(self, "_drain_jit"):
+            def fn(s):
+                s = self._drain_fn(s)
+                return (self._check_conservation(s) if self.check_every
+                        else s)
+
+            self._drain_jit = jax.jit(jax.vmap(fn), donate_argnums=0)
+        return self._drain_jit(state)
 
     def run_storm(self, state: DenseState, program,
                   drain: bool = True) -> DenseState:
@@ -574,20 +633,30 @@ class BatchedRunner:
 
     @staticmethod
     def summarize(state: DenseState) -> dict:
+        from chandy_lamport_tpu.core.state import decode_error_bits
         from chandy_lamport_tpu.utils.metrics import or_reduce
 
+        bits = int(or_reduce(state.error))
+        fc = jnp.sum(state.fault_counts, axis=0)
         return {
             "instances": int(state.time.shape[0]),
             "total_ticks": int(jnp.sum(state.time)),
             "max_time": int(jnp.max(state.time)),
             "error_lanes": int(jnp.sum(state.error != 0)),
-            # which bits fired across ALL lanes (int(max) would drop bits) —
-            # decode with core.state.decode_errors; the round-2 bench zeroed
-            # the perf axis without ever reporting WHICH flag fired
-            "error_bits": int(or_reduce(state.error)),
+            # which bits fired across ALL lanes (int(max) would drop bits);
+            # the short names ride along so no consumer has to decode the
+            # raw int by hand — the round-2 bench zeroed the perf axis
+            # without ever reporting WHICH flag fired
+            "error_bits": bits,
+            "errors_decoded": decode_error_bits(bits),
             "snapshots_started": int(jnp.sum(state.started)),
             "snapshots_completed": int(jnp.sum(
                 jnp.sum(state.started & (state.completed >= state.has_local.shape[-1]),
                         axis=-1))),
             "total_tokens_resident": int(jnp.sum(state.tokens)),
+            # adversary books (models/faults.py): events per class + the
+            # injected token delta conservation_delta subtracts
+            "fault_events": {"drops": int(fc[0]), "dups": int(fc[1]),
+                             "jitters": int(fc[2]), "crashes": int(fc[3])},
+            "fault_skew": int(jnp.sum(state.fault_skew)),
         }

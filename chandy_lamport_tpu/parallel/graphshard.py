@@ -202,7 +202,8 @@ class GraphShardedRunner:
     def __init__(self, topology: TopologySpec, config: Optional[SimConfig],
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
                  max_delay: int = 5, fixed_delay: Optional[int] = None,
-                 check_every: int = 0, queue_engine: str = "auto"):
+                 check_every: int = 0, queue_engine: str = "auto",
+                 quarantine: bool = False):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -218,7 +219,16 @@ class GraphShardedRunner:
         append scatters over the packed planes, "mask" = the [Em, C]
         one-hot formulation, "auto" (default) = backend-resolved
         (ops/tick.resolve_queue_engine). All ring state is shard-local,
-        so the choice changes no collective."""
+        so the choice changes no collective.
+
+        quarantine: freeze the instance the moment its (replicated)
+        sticky error bits fire — storm phases, drain and flush all treat
+        ``error != 0`` like the completion exit. The predicate is
+        replicated, so the gating conds stay uniform across shards (same
+        SPMD discipline as the conservation-check cond); in the batched
+        data x graph mode the gate applies per lane under vmap. Fault
+        INJECTION stays a dense/batched-path feature — ShardedState
+        carries no adversary leaves."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.mesh = mesh
@@ -228,6 +238,7 @@ class GraphShardedRunner:
         if check_every < 0:
             raise ValueError("check_every must be >= 0 (0 = off)")
         self.check_every = int(check_every)
+        self.quarantine = bool(quarantine)
         self.queue_engine = resolve_queue_engine(queue_engine)
         self.max_delay = fixed_delay if fixed_delay is not None else max_delay
         self.fixed_delay = fixed_delay
@@ -394,7 +405,7 @@ class GraphShardedRunner:
         smaller bit and decode_errors would mislabel the cause. Per-bit
         psum>0 preserves every flag."""
         mask = jnp.asarray(mask, _i32)
-        shifts = jnp.arange(8, dtype=_i32)  # 6 ERR_ bits defined; headroom
+        shifts = jnp.arange(8, dtype=_i32)  # 8 ERR_ bits defined (state.py)
         bits = (mask[..., None] >> shifts) & 1
         any_bit = lax.psum(bits, self.axis) > 0
         return jnp.sum(any_bit.astype(_i32) << shifts, axis=-1, dtype=_i32)
@@ -713,7 +724,15 @@ class GraphShardedRunner:
         k = self.check_every
 
         def phase(s, xs):
-            s = self._storm_phase(s, st, xs[0], xs[1])
+            if self.quarantine:
+                # replicated predicate -> uniform cond across shards (the
+                # same discipline as the conservation-check cond below)
+                s = lax.cond(s.error == 0,
+                             lambda s: self._storm_phase(s, st, xs[0],
+                                                         xs[1]),
+                             lambda s: s, s)
+            else:
+                s = self._storm_phase(s, st, xs[0], xs[1])
             if k:
                 # the predicate is replicated, so the cond (whose true
                 # branch psums) stays uniform across shards
@@ -748,15 +767,33 @@ class GraphShardedRunner:
 
     def _drain_flush(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
         """Tick until every started snapshot completes (budgeted), then
-        max_delay+1 flush ticks (test_common.go:124-137)."""
+        max_delay+1 flush ticks (test_common.go:124-137). With quarantine
+        on, the replicated error bits halt the instance like completion
+        (no ERR_TICK_LIMIT charge for quarantine-denied ticks)."""
         limit = jnp.asarray(s.time + self.config.max_ticks, _i32)
-        s = lax.while_loop(
-            lambda s: self._pending(s) & (s.time < limit),
-            lambda s: self._sync_tick(s, st), s)
+        if self.quarantine:
+            def cond(s):
+                return (self._pending(s) & (s.time < limit)
+                        & (s.error == 0))
+
+            def flush(s):
+                return lax.cond(s.error == 0,
+                                lambda s: self._sync_tick(s, st),
+                                lambda s: s, s)
+        else:
+            def cond(s):
+                return self._pending(s) & (s.time < limit)
+
+            def flush(s):
+                return self._sync_tick(s, st)
+        s = lax.while_loop(cond, lambda s: self._sync_tick(s, st), s)
+        budget_blown = self._pending(s)
+        if self.quarantine:
+            budget_blown = budget_blown & (s.error == 0)
         s = s._replace(error=s.error | jnp.where(
-            self._pending(s), ERR_TICK_LIMIT, 0).astype(_i32))
+            budget_blown, ERR_TICK_LIMIT, 0).astype(_i32))
         return lax.fori_loop(0, self.config.max_delay + 1,
-                             lambda _, s: self._sync_tick(s, st), s)
+                             lambda _, s: flush(s), s)
 
     def _run_script_body(self, s: ShardedState, st: ShardedTopology,
                          script: ShardedScript) -> ShardedState:
@@ -942,6 +979,11 @@ class GraphShardedRunner:
             rec_end=slot_edges(h.rec_end),
             completed=np.asarray(h.completed),
             delay_state=(),
+            # the sharded runner carries no fault adversary (its class
+            # docstring); the reassembled dense state is fault-clean
+            fault_key=np.uint32(0),
+            fault_skew=np.int32(0),
+            fault_counts=np.zeros(4, np.int32),
             error=np.asarray(h.error),
         )
 

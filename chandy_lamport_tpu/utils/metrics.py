@@ -52,8 +52,13 @@ def total_tokens(state: DenseState, cfg: SimConfig) -> jnp.ndarray:
 def conservation_delta(state: DenseState, cfg: SimConfig,
                        expected_total: int) -> jnp.ndarray:
     """0 iff conservation holds (expected_total = initial tokens summed over
-    however many instances the state carries)."""
-    return total_tokens(state, cfg) - expected_total
+    however many instances the state carries). The fault adversary's
+    injected token delta (``fault_skew``: duplicates - drops +
+    crash-restore deltas, models/faults.py) is subtracted, so conservation
+    stays an exact invariant on faulted lanes too — a nonzero delta always
+    means the SIMULATOR leaked tokens, never that the adversary was on."""
+    return (total_tokens(state, cfg) - expected_total
+            - jnp.sum(state.fault_skew))
 
 
 def progress_counters(state: DenseState, cfg: SimConfig,
@@ -120,7 +125,8 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     # (start/end) + split-marker planes m_pending/m_rtime/m_key
     snaps = s * (1 + n * (1 + 4 + 4 + 1)
                  + e * (1 + win * 2) + e * (1 + 4 + 4))
-    scalars = 4 * 3 + s * 4                             # time/next_sid/error, completed
+    # time/next_sid/error + fault_key/fault_skew/fault_counts[4], completed
+    scalars = 4 * 3 + 4 * 6 + s * 4
     return queues + nodes + rec_log + snaps + scalars
 
 

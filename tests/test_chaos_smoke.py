@@ -1,0 +1,38 @@
+"""tools/chaos_smoke.py in tier-1: the robustness canary must stay green.
+
+One subprocess run of the whole battery — every fault class injected once,
+recovery (or quarantine, for the deliberately-unrecoverable scenario)
+asserted by the tool itself; this test just demands the verdict and pins
+the JSON shape the CI driver consumes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_chaos_smoke_battery_green():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=240)
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    names = [r["scenario"] for r in verdict["scenarios"]]
+    # each fault class injected at least once, plus both crash outcomes
+    assert {"msg-faults", "crash-pause", "crash-lossy-recovered",
+            "crash-lossy-unrecovered"} <= set(names)
+    msg = next(r for r in verdict["scenarios"]
+               if r["scenario"] == "msg-faults")
+    for cls in ("drops", "dups", "jitters"):
+        assert msg["fault_events"][cls] > 0
+    for row in verdict["scenarios"]:
+        assert row["conservation_delta"] == 0
+        assert row["ok"], row
+    unrec = next(r for r in verdict["scenarios"]
+                 if r["scenario"] == "crash-lossy-unrecovered")
+    assert unrec["errors_decoded"] == ["ERR_FAULT_UNRECOVERED"]
+    assert unrec["quarantined_lanes"] > 0

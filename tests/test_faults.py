@@ -1,0 +1,260 @@
+"""The deterministic fault adversary (models/faults.py) and lane quarantine.
+
+Four claims, each load-bearing for the robustness PR:
+
+  1. OFF IS FREE AND EXACT — with a zero-rate engine (instrumentation in
+     the trace, every mask False) all 7 reference goldens stay bit-identical
+     to the uninstrumented kernels, and a batched storm's full final state
+     matches faults=None leaf for leaf.
+  2. EVERY CLASS FIRES AND THE BOOKS BALANCE — drop/dup/jitter/crash each
+     produce nonzero event counts under modest rates, and the skew-adjusted
+     conservation delta stays exactly zero (utils/metrics.py): the adversary
+     moves tokens, it never leaks them.
+  3. RECOVERY — a lossy crash AFTER a completed Chandy-Lamport snapshot
+     restores from the snapshot's frozen cut (no error bits); the same crash
+     BEFORE any completed snapshot raises ERR_FAULT_UNRECOVERED and the lane
+     quarantines (freezes) instead of grinding corrupt state forward.
+  4. ISOLATION — a quarantined lane never changes healthy lanes' final
+     states: arming the adversary on lane 0 only leaves every other lane
+     bit-identical to an all-disarmed run.
+
+Every distinct (rates, scheduler) pair costs a fresh XLA trace, so the
+tests share runners and vary only runtime data (fault_key) where the claim
+allows — seeds live in the key, not the trace. The deepest differentials
+(golden parity x7, per-class storms on both schedulers, the scheduled
+recovery-vs-quarantine pair) carry the ``slow`` marker: tier-1 runs under a
+hard wall-clock budget and tools/chaos_smoke.py already exercises every
+fault class + both crash outcomes there; full passes run everything.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.api import run_events_file
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import (
+    ERR_FAULT_UNRECOVERED,
+    decode_error_bits,
+)
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import (
+    ring_topology,
+    scale_free,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.compare import assert_snapshots_equal, sort_snapshots
+from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+from chandy_lamport_tpu.utils.metrics import conservation_delta
+
+SPEC = scale_free(24, 2, seed=5, tokens=100)
+CFG = SimConfig.for_workload(snapshots=4, max_recorded=64)
+BATCH = 4
+
+
+def _storm(faults, scheduler="exact", phases=12, quarantine=None,
+           spec=SPEC, cfg=CFG, delay=None, state_patch=None, runner=None):
+    if runner is None:
+        runner = BatchedRunner(
+            spec, cfg, delay or make_fast_delay("hash", 11), batch=BATCH,
+            scheduler=scheduler, faults=faults,
+            quarantine=(faults is not None) if quarantine is None
+            else quarantine)
+    prog = storm_program(
+        runner.topo, phases=phases, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 2, 1, 2,
+                                            max_phases=phases))
+    state = runner.init_batch()
+    if state_patch is not None:
+        state = state_patch(state)
+    return runner, jax.device_get(runner.run_storm(state, prog))
+
+
+def _leaves_sans_key(state):
+    # fault_key differs between armed and disarmed runs by construction;
+    # every OTHER leaf must match bit for bit
+    return jax.tree_util.tree_leaves(state._replace(fault_key=0))
+
+
+# ---- claim 1: off is free and exact ------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=[t[1].removesuffix(".events")
+                              for t in REFERENCE_TESTS])
+def test_zero_rate_adversary_keeps_goldens_bit_exact(top, events, snaps):
+    actual, sim = run_events_file(fixture_path(top), fixture_path(events),
+                                  backend="jax", faults=JaxFaults(7))
+    expected = [read_snapshot_file(fixture_path(f)) for f in snaps]
+    assert len(actual) == len(expected)
+    for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+        assert_snapshots_equal(e, a)
+
+
+@pytest.mark.parametrize("scheduler", [
+    "exact", pytest.param("sync", marks=pytest.mark.slow)])
+def test_zero_rate_storm_bit_identical_to_off(scheduler):
+    _, off = _storm(None, scheduler=scheduler)
+    _, zero = _storm(JaxFaults(7), scheduler=scheduler)
+    for a, b in zip(_leaves_sans_key(off), _leaves_sans_key(zero)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- claim 2: every class fires, books balance -------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scheduler", ["exact", "sync"])
+@pytest.mark.parametrize("classes,kw", [
+    # one trace covers all three message-plane classes; the node-plane
+    # crash program is a separate trace (it changes the restart hook)
+    (("drops", "dups", "jitters"),
+     {"drop_rate": 0.05, "dup_rate": 0.05, "jitter_rate": 0.05}),
+    (("crashes",),
+     {"crash_rate": 0.3, "crash_mode": "pause", "crash_period": 8,
+      "crash_len": 2}),
+])
+def test_fault_classes_fire_and_conserve(classes, kw, scheduler):
+    runner, final = _storm(JaxFaults(3, **kw), scheduler=scheduler)
+    summary = BatchedRunner.summarize(final)
+    for cls in classes:
+        assert summary["fault_events"][cls] > 0, summary["fault_events"]
+    expected = int(runner.topo.tokens0.sum()) * BATCH
+    assert int(conservation_delta(final, CFG, expected)) == 0
+    # pause crashes and drop/dup/jitter are all recoverable in-run: no lane
+    # may end poisoned
+    assert summary["error_lanes"] == 0, summary["errors_decoded"]
+
+
+def test_fault_program_replays_bit_exactly():
+    adversary = JaxFaults(3, drop_rate=0.05, dup_rate=0.05, jitter_rate=0.05)
+    runner, a = _storm(adversary)
+    _, b = _storm(adversary, runner=runner)        # same trace, same keys
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # a different seed is runtime data (the fault_key ramp), not a new
+    # trace: rerun the SAME compiled storm under seed-4 keys
+    other = JaxFaults(4, drop_rate=0.05, dup_rate=0.05, jitter_rate=0.05)
+    _, c = _storm(adversary, runner=runner, state_patch=lambda s: s._replace(
+        fault_key=np.asarray(other.init_batch_state(BATCH))))
+    assert (BatchedRunner.summarize(a)["fault_events"]
+            != BatchedRunner.summarize(c)["fault_events"])
+
+
+# ---- claim 3: snapshot-rollback recovery vs quarantine -----------------
+
+RING = ring_topology(8, tokens=100)
+RING_CFG = SimConfig.for_workload(snapshots=2, max_recorded=128)
+
+
+def _ring_storm(faults, phases=60):
+    runner = BatchedRunner(RING, RING_CFG, FixedJaxDelay(1), batch=2,
+                           scheduler="exact", faults=faults,
+                           quarantine=faults is not None)
+    prog = storm_program(
+        runner.topo, phases=phases, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 1, 1, 2,
+                                            max_phases=phases))
+    return runner, jax.device_get(runner.run_storm(runner.init_batch(), prog))
+
+
+@functools.lru_cache(maxsize=1)
+def _healthy_ring():
+    return _ring_storm(None)
+
+
+@pytest.mark.slow
+def test_lossy_crash_recovers_from_completed_snapshot():
+    # snapshot initiates at phase 1 and (ring of 8, fixed delay 1) completes
+    # well before tick 35; the deterministic crash window [35, 37) then
+    # kills EVERY node — each must restore from the snapshot's frozen cut
+    _, healthy = _healthy_ring()
+    runner, final = _ring_storm(JaxFaults(3, crash_rate=1.0,
+                                          crash_mode="lossy",
+                                          crash_start=35, crash_len=2))
+    summary = BatchedRunner.summarize(final)
+    assert summary["fault_events"]["crashes"] > 0
+    assert summary["error_lanes"] == 0, summary["errors_decoded"]
+    assert (summary["snapshots_completed"]
+            == BatchedRunner.summarize(healthy)["snapshots_completed"])
+    expected = int(runner.topo.tokens0.sum()) * 2
+    assert int(conservation_delta(final, RING_CFG, expected)) == 0
+
+
+@pytest.mark.slow
+def test_lossy_crash_without_snapshot_quarantines():
+    # the same crash at tick 5 — before any snapshot completes — is
+    # genuinely unrecoverable: ERR_FAULT_UNRECOVERED fires and the lane
+    # freezes at its poisoning tick instead of running the storm out
+    _, healthy = _healthy_ring()
+    _, final = _ring_storm(JaxFaults(3, crash_rate=1.0, crash_mode="lossy",
+                                     crash_start=5, crash_len=2))
+    errs = np.asarray(final.error)
+    assert np.all(errs & ERR_FAULT_UNRECOVERED)
+    assert decode_error_bits(int(errs[0])) == ["ERR_FAULT_UNRECOVERED"]
+    # frozen: the quarantined lanes' clocks stopped at the restart tick,
+    # far short of the healthy run's final time
+    assert np.all(np.asarray(final.time) < np.asarray(healthy.time))
+
+
+# ---- claim 4: quarantine isolation -------------------------------------
+
+
+def test_quarantined_lane_never_touches_healthy_lanes():
+    adversary = JaxFaults(3, crash_rate=1.0, crash_mode="lossy",
+                          crash_start=5, crash_len=2)
+
+    def arm_lane0_only(state):
+        key = np.asarray(state.fault_key).copy()
+        key[1:] = 0                      # zero key = disarmed (faults.py)
+        return state._replace(fault_key=key)
+
+    def disarm_all(state):
+        return state._replace(
+            fault_key=np.zeros_like(np.asarray(state.fault_key)))
+
+    runner, mixed = _storm(adversary, quarantine=True,
+                           state_patch=arm_lane0_only)
+    _, clean = _storm(adversary, runner=runner, state_patch=disarm_all)
+    assert int(mixed.error[0]) & ERR_FAULT_UNRECOVERED
+    assert not np.any(np.asarray(mixed.error)[1:])
+    for a, b in zip(_leaves_sans_key(mixed), _leaves_sans_key(clean)):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.ndim == 0 or a.shape[0] != BATCH:
+            continue                     # per-lane leaves only
+        np.testing.assert_array_equal(a[1:], b[1:])
+
+
+# ---- construction-time contracts ---------------------------------------
+
+
+def test_fold_refuses_fault_engine():
+    with pytest.raises(ValueError, match="fold"):
+        BatchedRunner(SPEC, CFG, make_fast_delay("hash", 11), batch=2,
+                      scheduler="exact", exact_impl="fold",
+                      faults=JaxFaults(7))
+
+
+def test_parity_backend_refuses_fault_engine():
+    with pytest.raises(ValueError, match="parity"):
+        run_events_file(fixture_path("2nodes.top"),
+                        fixture_path("2nodes-message.events"),
+                        backend="parity", faults=JaxFaults(7))
+
+
+@pytest.mark.parametrize("kw", [
+    {"drop_rate": -0.1}, {"dup_rate": 1.5},
+    {"crash_mode": "explode"},
+    {"crash_len": 0}, {"crash_len": 32, "crash_period": 32},
+])
+def test_adversary_rejects_bad_programs(kw):
+    with pytest.raises(ValueError):
+        JaxFaults(7, **kw)

