@@ -181,6 +181,15 @@ def _parser() -> argparse.ArgumentParser:
                         "collective-formulation tax vs the unsharded sync "
                         "path at the same shape (VERDICT r3 #4). --batch "
                         "is ignored (B=1). Node count must divide by K.")
+    p.add_argument("--snapshot-timeout", type=int, default=0, metavar="T",
+                   help="snapshot supervisor (SimConfig.snapshot_timeout): "
+                        "abort + retry snapshot attempts not completed "
+                        "within T ticks; 0 = off (the default bench regime)")
+    p.add_argument("--snapshot-retries", type=int, default=3,
+                   help="retry budget per snapshot before "
+                        "ERR_SNAPSHOT_TIMEOUT")
+    p.add_argument("--snapshot-every", type=int, default=0, metavar="K",
+                   help="snapshot daemon cadence in ticks (0 = off)")
     p.add_argument("--target", type=float, default=10e6,
                    help="north-star node-ticks/sec/chip (BASELINE.json)")
     p.add_argument("--profile", metavar="DIR", default=None,
@@ -355,7 +364,10 @@ def run_worker(args) -> int:
                                  max_recorded=args.max_recorded,
                                  record_dtype=args.record_dtype,
                                  window_dtype=args.window_dtype,
-                                 split_markers=args.scheduler == "sync")
+                                 split_markers=args.scheduler == "sync",
+                                 snapshot_timeout=args.snapshot_timeout,
+                                 snapshot_retries=args.snapshot_retries,
+                                 snapshot_every=args.snapshot_every)
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
 
@@ -502,6 +514,16 @@ def run_worker(args) -> int:
         # raw ints (core/state.decode_error_bits)
         "error_bits": summary["error_bits"],
         "errors_decoded": summary["errors_decoded"],
+        # supervisor lifecycle per run (utils/metrics.snapshot_lifecycle):
+        # even the supervisor-off default row carries the counters (all
+        # zero churn) so the ladder's round-trip can rely on the field
+        "snapshot_lifecycle": summary["snapshot_lifecycle"],
+        "recovery_line_age": summary["snapshot_lifecycle"][
+            "recovery_line_age_max"],
+        **({"snapshot_timeout": args.snapshot_timeout,
+            "snapshot_retries": args.snapshot_retries,
+            "snapshot_every": args.snapshot_every}
+           if (args.snapshot_timeout or args.snapshot_every) else {}),
     }
     result.update(mem)
     if dev.platform != "tpu":

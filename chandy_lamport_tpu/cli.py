@@ -134,11 +134,15 @@ def _cmd_storm(args) -> int:
         record_dtype=args.record_dtype, window_dtype=args.window_dtype,
         reduce_mode=args.reduce_mode,
         split_markers=args.scheduler == "sync",
+        snapshot_timeout=args.snapshot_timeout,
+        snapshot_retries=args.snapshot_retries,
+        snapshot_every=args.snapshot_every,
         **({"queue_capacity": args.queue_capacity}
            if args.queue_capacity else {}))
     faults = None
     if any((args.fault_drop, args.fault_dup, args.fault_jitter,
-            args.fault_crash)):
+            args.fault_crash, args.marker_fault_drop, args.marker_fault_dup,
+            args.marker_fault_jitter)):
         from chandy_lamport_tpu.models.faults import JaxFaults
 
         faults = JaxFaults(
@@ -146,7 +150,10 @@ def _cmd_storm(args) -> int:
             drop_rate=args.fault_drop, dup_rate=args.fault_dup,
             jitter_rate=args.fault_jitter, crash_rate=args.fault_crash,
             crash_mode=args.crash_mode, crash_len=args.crash_len,
-            crash_period=args.crash_period)
+            crash_period=args.crash_period,
+            marker_drop_rate=args.marker_fault_drop,
+            marker_dup_rate=args.marker_fault_dup,
+            marker_jitter_rate=args.marker_fault_jitter)
     # an armed adversary quarantines by default: an injured lane freezes
     # with its decoded bits surfaced instead of poisoning the aggregates
     quarantine = args.quarantine or faults is not None
@@ -213,6 +220,11 @@ def _cmd_storm(args) -> int:
     expected = int(runner.topo.tokens0.sum()) * args.batch
     counters["conservation_delta"] = int(
         conservation_delta(final, cfg, expected))
+    # supervisor lifecycle row (initiated/completed/aborted/retried/
+    # failed/stale_markers + recovery-line age), always present so a
+    # supervisor-off run visibly reports zero churn
+    counters["snapshot_lifecycle"] = BatchedRunner.summarize(
+        final)["snapshot_lifecycle"]
     errs = np.asarray(jax.device_get(final.error))
     if faults is not None:
         summary = BatchedRunner.summarize(final)
@@ -355,6 +367,32 @@ def main(argv=None) -> int:
                     help="crash window length in ticks")
     ps.add_argument("--crash-period", type=int, default=32,
                     help="crash window cadence in ticks")
+    ps.add_argument("--marker-fault-drop", type=float, default=0.0,
+                    metavar="R",
+                    help="marker-plane adversary (models/faults.py): "
+                         "per-(edge, tick) MARKER-drop probability — the "
+                         "control-plane loss the snapshot supervisor "
+                         "(--snapshot-timeout) recovers from")
+    ps.add_argument("--marker-fault-dup", type=float, default=0.0,
+                    metavar="R",
+                    help="per-(edge, tick) marker-duplicate probability")
+    ps.add_argument("--marker-fault-jitter", type=float, default=0.0,
+                    metavar="R",
+                    help="per-(edge, tick) marker-front stall probability")
+    ps.add_argument("--snapshot-timeout", type=int, default=0, metavar="T",
+                    help="snapshot supervisor (SimConfig.snapshot_timeout): "
+                         "abort + re-initiate (fresh epoch, doubling "
+                         "deadline) any snapshot attempt not completed "
+                         "within T ticks; 0 = off")
+    ps.add_argument("--snapshot-retries", type=int, default=3,
+                    help="re-initiations per snapshot before the slot is "
+                         "marked failed and the lane raises "
+                         "ERR_SNAPSHOT_TIMEOUT")
+    ps.add_argument("--snapshot-every", type=int, default=0, metavar="K",
+                    help="snapshot daemon: initiate a snapshot every K "
+                         "ticks (rotating initiator) while slots remain, "
+                         "keeping the lossy-crash recovery line fresh; "
+                         "0 = off")
     ps.add_argument("--quarantine", action="store_true",
                     help="freeze a lane the moment its error bits fire "
                          "(auto-enabled whenever a fault rate is set)")

@@ -67,6 +67,25 @@ class SimConfig:
     # "segsum" uses O(E) integer prefix-sum segment reductions (exact at
     # any scale, no large constants). "auto" picks by graph size.
     reduce_mode: str = "auto"
+    # Snapshot supervisor (ops/tick.TickKernel._supervise): with
+    # snapshot_timeout > 0, a started snapshot that has not completed
+    # within that many ticks of its (re-)initiation is aborted IN TRACE —
+    # slot released, recorded windows cleared, channels un-frozen — and
+    # re-initiated from the remembered initiator under a fresh marker
+    # EPOCH (stragglers of the dead attempt are rejected as stale), with
+    # the deadline doubling per retry (capped at 16x). After
+    # snapshot_retries failed attempts the slot is marked failed and the
+    # lane raises ERR_SNAPSHOT_TIMEOUT. 0 disables the supervisor — the
+    # kernels trace zero supervisor ops (the faults=None contract).
+    snapshot_timeout: int = 0
+    snapshot_retries: int = 3
+    # Snapshot daemon: with snapshot_every > 0 the tick kernels initiate a
+    # snapshot every that-many ticks from a rotating initiator while free
+    # slots remain (next_sid < max_snapshots), so lossy crashes always
+    # find a recent recovery line (recovery-line age is surfaced by
+    # utils/metrics.snapshot_lifecycle). Size max_snapshots to
+    # run_length / snapshot_every.
+    snapshot_every: int = 0
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
@@ -88,6 +107,11 @@ class SimConfig:
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
+        if (self.snapshot_timeout < 0 or self.snapshot_retries < 0
+                or self.snapshot_every < 0):
+            raise ValueError(
+                "snapshot_timeout/snapshot_retries/snapshot_every must be "
+                ">= 0 (0 disables the supervisor / daemon)")
 
     @classmethod
     def for_workload(cls, *, snapshots: int, max_delay: int = MAX_DELAY,

@@ -59,12 +59,17 @@ ERR_TICK_LIMIT = 16
 ERR_VALUE_OVERFLOW = 32
 ERR_CONSERVATION = 64
 ERR_FAULT_UNRECOVERED = 128
+ERR_SNAPSHOT_TIMEOUT = 256
 
-# fault_counts[4] event-class indices (models/faults.py adversary): message
-# drops, message duplicates, per-(edge, tick) extra-delay jitter stalls, and
-# node crash restarts — per-lane evidence that an injected fault class
-# actually fired (tools/chaos_smoke.py asserts on these)
+# fault_counts[7] event-class indices (models/faults.py adversary): message
+# drops, message duplicates, per-(edge, tick) extra-delay jitter stalls,
+# node crash restarts, and the MARKER-plane classes (control-plane drops/
+# duplicates/jitter stalls — the faults the snapshot supervisor exists to
+# survive) — per-lane evidence that an injected fault class actually fired
+# (tools/chaos_smoke.py asserts on these)
 FC_DROP, FC_DUP, FC_JITTER, FC_CRASH = 0, 1, 2, 3
+FC_MDROP, FC_MDUP, FC_MJITTER = 4, 5, 6
+NUM_FAULT_CLASSES = 7
 
 # largest token amount the sync scheduler's f32 incidence matmuls carry
 # exactly; amounts at or beyond this fire ERR_VALUE_OVERFLOW instead of
@@ -98,6 +103,28 @@ def meta_marker(meta):
     """Marker bit of a packed slot word (bool)."""
     return (meta & 1) == 1
 
+
+def pack_marker_data(sid, epoch, max_snapshots: int):
+    """Ring-mode marker payload word: ``epoch * S + sid`` — (sid, epoch)
+    packed into the full-range ``q_data`` slot. Epoch 0 packs to the bare
+    sid, so a supervisor that never fires (and every pre-supervisor
+    golden) carries bit-identical ring content. THE payload definition —
+    producers (_push_marker/_broadcast_markers) and the delivery-side
+    decode (marker_data_sid/marker_data_epoch) share it so the encoding
+    cannot drift."""
+    return epoch * max_snapshots + sid
+
+
+def marker_data_sid(data, max_snapshots: int):
+    """Snapshot id of a packed marker payload word."""
+    return data % max_snapshots
+
+
+def marker_data_epoch(data, max_snapshots: int):
+    """Epoch of a packed marker payload word (stale-arrival rejection:
+    ops/tick.TickKernel._reject_stale compares it to ``snap_epoch``)."""
+    return data // max_snapshots
+
 ERROR_NAMES = {
     ERR_QUEUE_OVERFLOW: "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)",
     ERR_SNAPSHOT_OVERFLOW: "concurrent snapshot slots exceeded (raise SimConfig.max_snapshots)",
@@ -125,6 +152,12 @@ ERROR_NAMES = {
                            "node's un-snapshotted balance is gone; "
                            "quarantine the lane or schedule snapshots "
                            "ahead of the crash windows)",
+    ERR_SNAPSHOT_TIMEOUT: "a snapshot attempt missed its "
+                          "SimConfig.snapshot_timeout deadline "
+                          "snapshot_retries times in a row and was marked "
+                          "failed by the supervisor (sustained marker loss "
+                          "beyond the retry budget — raise the timeout/"
+                          "retries, or lower the marker fault rates)",
 }
 
 # short symbol-style names for user-facing output (CLI counters, bench JSON
@@ -138,6 +171,7 @@ ERROR_BIT_NAMES = {
     ERR_VALUE_OVERFLOW: "ERR_VALUE_OVERFLOW",
     ERR_CONSERVATION: "ERR_CONSERVATION",
     ERR_FAULT_UNRECOVERED: "ERR_FAULT_UNRECOVERED",
+    ERR_SNAPSHOT_TIMEOUT: "ERR_SNAPSHOT_TIMEOUT",
 }
 
 
@@ -278,8 +312,26 @@ class DenseState(NamedTuple):
     fault_skew: Any    # i32 [] token delta the adversary injected
     #                    (duplicates - drops + crash-restore deltas);
     #                    conservation_delta subtracts it
-    fault_counts: Any  # i32 [4] fault events by class (FC_DROP/FC_DUP/
-    #                    FC_JITTER/FC_CRASH)
+    fault_counts: Any  # i32 [7] fault events by class (FC_DROP/FC_DUP/
+    #                    FC_JITTER/FC_CRASH + marker-plane FC_MDROP/
+    #                    FC_MDUP/FC_MJITTER)
+    # snapshot-supervisor state (SimConfig.snapshot_timeout/_every;
+    # checkpoint format v5 leaves). An ATTEMPT of snapshot slot s is
+    # identified by (s, snap_epoch[s]): the supervisor's abort bumps the
+    # epoch, so ring markers of a superseded attempt — which cannot be
+    # plucked out of FIFO ring buffers — are rejected on delivery and
+    # tallied in stale_markers instead of corrupting the fresh cut (the
+    # split representation clears its pending planes in place, so
+    # staleness is structurally impossible there).
+    snap_epoch: Any     # i32 [S] current attempt epoch per slot
+    snap_deadline: Any  # i32 [S] abort tick of the live attempt (0 = unarmed)
+    snap_retries: Any   # i32 [S] re-initiations consumed
+    snap_initiator: Any  # i32 [S] initiator node (re-initiation target; -1)
+    snap_failed: Any    # bool [S] retries exhausted (ERR_SNAPSHOT_TIMEOUT);
+    #                     a failed slot no longer gates the drain loop
+    snap_done_time: Any  # i32 [S] tick the snapshot completed on all nodes
+    #                     (-1 until then; recovery-line age metric)
+    stale_markers: Any  # i32 [] superseded-epoch marker arrivals rejected
     error: Any         # i32 [] sticky bitmask
 
 
@@ -319,7 +371,14 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any,
         delay_state=delay_state,
         fault_key=np.uint32(fault_key),
         fault_skew=np.int32(0),
-        fault_counts=np.zeros(4, i32),
+        fault_counts=np.zeros(NUM_FAULT_CLASSES, i32),
+        snap_epoch=np.zeros(s, i32),
+        snap_deadline=np.zeros(s, i32),
+        snap_retries=np.zeros(s, i32),
+        snap_initiator=np.full(s, -1, i32),
+        snap_failed=np.zeros(s, b),
+        snap_done_time=np.full(s, -1, i32),
+        stale_markers=np.int32(0),
         error=np.int32(0),
     )
 

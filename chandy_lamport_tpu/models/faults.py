@@ -17,9 +17,7 @@ Fault classes (applied by ops/tick.TickKernel under a single per-tick mask;
 the fault-free path stays bit-identical to the uninstrumented kernels):
 
   drop     a TOKEN selected for delivery is popped but lost (the amount is
-           neither credited nor recorded). Token-plane only: markers are the
-           protocol's control plane and are assumed reliable — dropping one
-           wedges the snapshot unrecoverably instead of testing recovery.
+           neither credited nor recorded).
   dup      a delivered token is ALSO re-enqueued on its edge with a fresh
            receive time drawn from the FAULT stream (never the delay
            sampler's, so the sampler stream is fault-invariant).
@@ -40,6 +38,16 @@ the fault-free path stays bit-identical to the uninstrumented kernels):
                       with no completed snapshot to roll back to — zeroed
                       with ERR_FAULT_UNRECOVERED raised for the lane.
 
+Marker-plane classes (``marker_drop_rate``/``marker_dup_rate``/
+``marker_jitter_rate``): the same drop/dup/jitter programs aimed at the
+protocol's CONTROL plane. PR 3 exempted markers ("dropping one wedges the
+snapshot unrecoverably"); the snapshot supervisor
+(SimConfig.snapshot_timeout, ops/tick.TickKernel._supervise) removes that
+excuse — a marker loss now stalls ONE attempt, which times out, is aborted
+under a bumped epoch and re-initiated. Marker faults move no tokens, so
+they never touch ``fault_skew``; their evidence is the FC_MDROP/FC_MDUP/
+FC_MJITTER tallies plus the supervisor's retry/stale counters.
+
 Bookkeeping: every injected token delta (dup - drop, crash-restore deltas)
 accumulates in ``DenseState.fault_skew``, so token conservation remains an
 exact in-run invariant under faults: utils.metrics.conservation_delta
@@ -58,7 +66,8 @@ _u32 = jnp.uint32
 
 # per-class hash domains: every (class, tick, index) triple draws a distinct
 # word, so the classes' streams never alias each other
-_CLS_DROP, _CLS_DUP, _CLS_JITTER, _CLS_CRASH, _CLS_DUP_DELAY = range(1, 6)
+(_CLS_DROP, _CLS_DUP, _CLS_JITTER, _CLS_CRASH, _CLS_DUP_DELAY,
+ _CLS_MDROP, _CLS_MDUP, _CLS_MJITTER, _CLS_MDUP_DELAY) = range(1, 10)
 
 
 def _word(key, cls: int, time, idx):
@@ -87,10 +96,16 @@ class JaxFaults:
                  crash_rate: float = 0.0, crash_len: int = 2,
                  crash_period: int = 32, crash_mode: str = "pause",
                  crash_start: int | None = None,
+                 marker_drop_rate: float = 0.0,
+                 marker_dup_rate: float = 0.0,
+                 marker_jitter_rate: float = 0.0,
                  max_delay: int = MAX_DELAY):
         for name, r in (("drop_rate", drop_rate), ("dup_rate", dup_rate),
                         ("jitter_rate", jitter_rate),
-                        ("crash_rate", crash_rate)):
+                        ("crash_rate", crash_rate),
+                        ("marker_drop_rate", marker_drop_rate),
+                        ("marker_dup_rate", marker_dup_rate),
+                        ("marker_jitter_rate", marker_jitter_rate)):
             if not 0.0 <= r <= 1.0:
                 raise ValueError(f"{name} must be in [0, 1], got {r}")
         if crash_mode not in ("pause", "lossy"):
@@ -108,6 +123,9 @@ class JaxFaults:
         self.crash_period = int(crash_period)
         self.crash_mode = crash_mode
         self.crash_start = None if crash_start is None else int(crash_start)
+        self.marker_drop_rate = float(marker_drop_rate)
+        self.marker_dup_rate = float(marker_dup_rate)
+        self.marker_jitter_rate = float(marker_jitter_rate)
         self.max_delay = int(max_delay)
 
     @property
@@ -125,7 +143,10 @@ class JaxFaults:
                 "crash": self.crash_rate, "crash_len": self.crash_len,
                 "crash_period": self.crash_period,
                 "crash_mode": self.crash_mode,
-                "crash_start": self.crash_start}
+                "crash_start": self.crash_start,
+                "marker_drop": self.marker_drop_rate,
+                "marker_dup": self.marker_dup_rate,
+                "marker_jitter": self.marker_jitter_rate}
 
     # -- stream keys (carried in DenseState.fault_key) ---------------------
 
@@ -172,6 +193,21 @@ class JaxFaults:
                 self._rate_mask(key, _CLS_JITTER, self.jitter_rate, time,
                                 idx),
                 _word(key, _CLS_DUP_DELAY, time, idx))
+
+    def marker_masks(self, key, time, num_edges: int):
+        """The marker-plane twin of ``edge_masks``: (drop, dup, jitter)
+        bool [E] masks for this tick's MARKER deliveries plus the dup
+        re-enqueue delay words (raw u32 [E]). Distinct hash classes, so
+        the token and marker programs never alias; zero rates contribute
+        all-False masks without hashing (the armed-but-idle oracle)."""
+        idx = jnp.arange(num_edges, dtype=_u32)
+        return (self._rate_mask(key, _CLS_MDROP, self.marker_drop_rate,
+                                time, idx),
+                self._rate_mask(key, _CLS_MDUP, self.marker_dup_rate,
+                                time, idx),
+                self._rate_mask(key, _CLS_MJITTER, self.marker_jitter_rate,
+                                time, idx),
+                _word(key, _CLS_MDUP_DELAY, time, idx))
 
     def down_nodes(self, key, time, num_nodes: int):
         """[N] bool: nodes down (crashed) at ``time``. Deterministic-window

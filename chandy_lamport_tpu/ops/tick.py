@@ -42,6 +42,7 @@ from chandy_lamport_tpu.core.state import (
     ERR_QUEUE_OVERFLOW,
     ERR_RECORD_OVERFLOW,
     ERR_SNAPSHOT_OVERFLOW,
+    ERR_SNAPSHOT_TIMEOUT,
     ERR_TICK_LIMIT,
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
@@ -50,9 +51,15 @@ from chandy_lamport_tpu.core.state import (
     FC_DROP,
     FC_DUP,
     FC_JITTER,
+    FC_MDROP,
+    FC_MDUP,
+    FC_MJITTER,
     RTIME_PACK_LIMIT,
+    marker_data_epoch,
+    marker_data_sid,
     meta_marker,
     meta_rtime,
+    pack_marker_data,
     pack_meta,
 )
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
@@ -267,7 +274,19 @@ class TickKernel:
         the drain/flush loops treat ``error != 0`` exactly like the
         quiescence exit, so a poisoned lane stops ticking instead of
         corrupting aggregate metrics (parallel/batch.py extends the
-        same gate to the storm phase scan)."""
+        same gate to the storm phase scan).
+
+        The snapshot SUPERVISOR is configured through cfg
+        (SimConfig.snapshot_timeout / snapshot_retries /
+        snapshot_every) and woven into the cascade, wave and sync ticks
+        (_supervise): attempts carry deadlines; a timed-out attempt is
+        aborted in trace and re-initiated under a bumped epoch (ring
+        markers carry (sid, epoch) packed in their payload —
+        state.pack_marker_data — and superseded arrivals are rejected
+        as stale); exhausted retries raise ERR_SNAPSHOT_TIMEOUT. Both
+        knobs at 0 (default) trace zero supervisor ops, and an
+        armed-but-idle supervisor is bit-identical to the unsupervised
+        kernel (tests/test_snapshot_supervisor.py)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
         if (faults is not None and marker_mode == "ring"
@@ -276,6 +295,17 @@ class TickKernel:
                 "exact_impl='fold' is the reference-literal specification "
                 "form and runs uninjured; use cascade/wave (or the sync "
                 "scheduler) for fault injection")
+        # the snapshot supervisor (cfg.snapshot_timeout / snapshot_every) is
+        # woven into the cascade/wave/sync ticks; the fold stays the
+        # unsupervised specification form for the same reason it refuses
+        # the fault engine
+        self._sup = bool(cfg.snapshot_timeout > 0 or cfg.snapshot_every > 0)
+        if self._sup and marker_mode == "ring" and exact_impl == "fold":
+            raise ValueError(
+                "exact_impl='fold' is the reference-literal specification "
+                "form and carries no snapshot supervisor; use cascade/wave "
+                "(or the sync scheduler) with snapshot_timeout/"
+                "snapshot_every")
         queue_engine = resolve_queue_engine(queue_engine)
         if megatick < 1:
             raise ValueError(f"megatick must be >= 1, got {megatick}")
@@ -475,17 +505,54 @@ class TickKernel:
             dupw_e % jnp.uint32(max(self.cfg.max_delay, 1)), _i32)
         return drop_e, dup_e, jit_e, dup_rt
 
-    def _fault_gate_elig(self, s: DenseState, elig, jit_e):
+    def _fault_marker_masks(self, s: DenseState):
+        """(drop, dup, jitter) bool [E] + dup receive times i32 [E] for
+        this tick's MARKER deliveries (models/faults.marker_masks): the
+        control-plane fault program the snapshot supervisor exists to
+        survive. Stateless per-tick hash — callers may recompute it
+        within a tick and read identical masks."""
+        md_e, mu_e, mj_e, mw_e = self.faults.marker_masks(
+            s.fault_key, s.time, self.topo.e)
+        mdup_rt = s.time + 1 + jnp.asarray(
+            mw_e % jnp.uint32(max(self.cfg.max_delay, 1)), _i32)
+        return md_e, mu_e, mj_e, mdup_rt
+
+    def _fault_split_markers(self, s: DenseState, mk_pend):
+        """Split this tick's delivered-marker mask by the adversary's
+        marker drop/dup program: a dropped marker vanishes on the wire
+        (popped, never handled — exactly the loss that stalls a snapshot
+        until the supervisor's timeout), a duplicated one is handled AND
+        re-enqueued by the caller with a fault-stream receive time.
+        Markers move no tokens, so no skew is booked. Returns
+        (state, surviving-marker mask, dup mask, dup receive times)."""
+        mdrop_e, mdup_e, _, mdup_rt = self._fault_marker_masks(s)
+        dropped = mk_pend & mdrop_e
+        duped = mk_pend & mdup_e & ~dropped
+        counts = s.fault_counts.at[FC_MDROP].add(
+            jnp.sum(dropped, dtype=_i32)).at[FC_MDUP].add(
+            jnp.sum(duped, dtype=_i32))
+        return (s._replace(fault_counts=counts),
+                mk_pend & ~dropped, duped, mdup_rt)
+
+    def _fault_gate_elig(self, s: DenseState, elig, jit_e, mjit_e=None,
+                         marker_front=None):
         """Apply the delivery-side fault gates to an eligibility mask:
-        extra-delay jitter stalls the edge's front for this tick, and a
-        down (crashed) destination receives nothing — its in-flight
-        messages WAIT (channels stay lossless; recovery is the point, not
-        message loss). Returns (state with jitter events counted, elig)."""
+        extra-delay jitter stalls the edge's front for this tick (with
+        ``mjit_e``/``marker_front``, the marker-plane jitter program
+        additionally stalls marker fronts), and a down (crashed)
+        destination receives nothing — its in-flight messages WAIT
+        (channels stay lossless; recovery is the point, not message
+        loss). Returns (state with jitter events counted, elig)."""
         blocked = elig & jit_e
+        counts = s.fault_counts.at[FC_JITTER].add(
+            jnp.sum(blocked, dtype=_i32))
+        if mjit_e is not None:
+            mblocked = elig & marker_front & mjit_e
+            counts = counts.at[FC_MJITTER].add(jnp.sum(mblocked, dtype=_i32))
+            blocked = blocked | mblocked
         down_n = self.faults.down_nodes(s.fault_key, s.time, self.topo.n)
         dead = elig & self._spread_dst(down_n)
-        s = s._replace(fault_counts=s.fault_counts.at[FC_JITTER].add(
-            jnp.sum(blocked, dtype=_i32)))
+        s = s._replace(fault_counts=counts)
         return s, elig & ~blocked & ~dead
 
     def _fault_split_tokens(self, s: DenseState, tok_e, amt_src, drop_e,
@@ -536,6 +603,162 @@ class TickKernel:
                                               dtype=_i32),
             fault_counts=counts,
             error=s.error | err)
+
+    # ---- snapshot supervisor (SimConfig.snapshot_timeout/_every) ---------
+    # Traced only when self._sup (the faults=None zero-cost contract: an
+    # unsupervised kernel contains zero supervisor ops). One shared scan/
+    # abort core serves the ring (cascade/wave) and split (sync) paths;
+    # only re-initiation differs by representation.
+
+    def _marker_payload(self, s: DenseState, sid):
+        """Ring-mode marker payload for slot ``sid``: (sid, epoch) packed
+        as ``epoch * S + sid`` (state.pack_marker_data) when the
+        supervisor is armed — epoch 0 packs to the bare sid, so an armed
+        supervisor that never fires keeps ring content bit-identical to
+        the unsupervised kernel — and the bare sid otherwise."""
+        sid = jnp.asarray(sid, _i32)
+        if not self._sup:
+            return sid
+        return pack_marker_data(sid, s.snap_epoch[sid],
+                                self.cfg.max_snapshots)
+
+    def _reject_stale(self, s: DenseState, mk_pend, head_data):
+        """Delivery-side epoch check for popped ring markers: decode
+        (sid, epoch) from the payload and reject arrivals whose epoch the
+        supervisor has superseded — an aborted attempt's markers cannot be
+        plucked out of the FIFO rings, so they drain naturally and die
+        HERE, counted in ``stale_markers``, instead of corrupting the
+        fresh attempt's cut. Returns (state, surviving markers, sid_e);
+        with the supervisor off this is the identity and ``sid_e`` is the
+        raw payload (bare sid)."""
+        if not self._sup:
+            return s, mk_pend, head_data
+        S = self.cfg.max_snapshots
+        sid_e = marker_data_sid(head_data, S)
+        stale = mk_pend & (marker_data_epoch(head_data, S)
+                           != s.snap_epoch[jnp.clip(sid_e, 0, S - 1)])
+        s = s._replace(stale_markers=s.stale_markers
+                       + jnp.sum(stale, dtype=_i32))
+        return s, mk_pend & ~stale, sid_e
+
+    def _sup_scan(self, s: DenseState):
+        """Timeout scan: abort every snapshot attempt whose deadline
+        passed — slot released (cut state cleared, recorded windows
+        zeroed, channels un-frozen), epoch bumped so the dead attempt's
+        in-flight markers are rejected as stale — then either schedule a
+        re-initiation (retries left; deadline doubles per retry, capped
+        at 16x) or mark the slot failed and raise ERR_SNAPSHOT_TIMEOUT.
+        ``min_prot`` is left conservative (never raised): an aborted
+        window's protection can only make ERR_RECORD_OVERFLOW fire
+        early, never miss. Returns (state, retry mask [S])."""
+        n = self.topo.n
+        timed_out = (s.started & ~s.snap_failed & (s.completed < n)
+                     & (s.snap_deadline > 0) & (s.time >= s.snap_deadline))
+        can_retry = timed_out & (s.snap_retries
+                                 < jnp.int32(self.cfg.snapshot_retries))
+        failed = timed_out & ~can_retry
+        t_b = timed_out[..., :, None]        # broadcasts over N and E dims
+        new_retries = s.snap_retries + can_retry.astype(_i32)
+        backoff = jnp.left_shift(
+            jnp.int32(max(self.cfg.snapshot_timeout, 1)),
+            jnp.minimum(new_retries, 4))
+        s = s._replace(
+            has_local=s.has_local & ~t_b,
+            done_local=s.done_local & ~t_b,
+            frozen=jnp.where(t_b, 0, s.frozen),
+            rem=jnp.where(t_b, 0, s.rem),
+            recording=s.recording & ~t_b,
+            rec_start=jnp.where(t_b, jnp.zeros_like(s.rec_start),
+                                s.rec_start),
+            rec_end=jnp.where(t_b, jnp.zeros_like(s.rec_end), s.rec_end),
+            completed=jnp.where(timed_out, 0, s.completed),
+            # split representation: the dead attempt's pending markers are
+            # wiped in place (ring markers die via the epoch check instead)
+            m_pending=s.m_pending & ~t_b,
+            snap_epoch=s.snap_epoch + timed_out.astype(_i32),
+            snap_retries=new_retries,
+            snap_failed=s.snap_failed | failed,
+            snap_deadline=jnp.where(can_retry, s.time + backoff,
+                                    jnp.where(failed, 0, s.snap_deadline)),
+            error=s.error | jnp.where(jnp.any(failed),
+                                      ERR_SNAPSHOT_TIMEOUT, 0).astype(_i32),
+        )
+        return s, can_retry
+
+    def _sup_reinitiate_ring(self, s: DenseState, retry) -> DenseState:
+        """Re-initiate each retried slot from its remembered initiator
+        (slot order = draw order), under the already-bumped epoch: a
+        fresh CreateLocalSnapshot recording ALL inbound links plus a
+        marker broadcast tagged with the new epoch. A zero-retry tick
+        runs zero loop iterations and draws nothing — the golden-parity
+        property for an armed-but-idle supervisor."""
+        S = self.cfg.max_snapshots
+
+        def body(carry):
+            s, m = carry
+            sid = jnp.argmax(m)
+            node = jnp.clip(s.snap_initiator[sid], 0, self.topo.n - 1)
+            s = self._create_local(s, sid, node, jnp.int32(-1))
+            s = self._broadcast_markers(s, node, sid)
+            return s, m & (jnp.arange(S, dtype=_i32) != sid)
+
+        s, _ = lax.while_loop(lambda c: jnp.any(c[1]), body, (s, retry))
+        return s
+
+    def _sup_reinitiate_split(self, s: DenseState, retry) -> DenseState:
+        """Split-mode re-initiation: one vectorized create+broadcast over
+        the retried slots' initiators, gated so its (S, E) delay draws
+        only happen on ticks where a retry actually fires."""
+        created = retry[..., :, None] & (
+            jnp.arange(self.topo.n, dtype=_i32)
+            == jnp.clip(s.snap_initiator, 0, self.topo.n - 1)[..., :, None])
+        return lax.cond(jnp.any(retry),
+                        lambda s: self._create_and_broadcast(s, created),
+                        lambda s: s, s)
+
+    def _sup_daemon(self, s: DenseState) -> DenseState:
+        """The snapshot_every daemon: initiate a snapshot from a rotating
+        initiator every K ticks while free slots remain, so lossy crashes
+        always find a recent recovery line. Gated under lax.cond — an
+        idle tick draws nothing."""
+        every = self.cfg.snapshot_every
+        S = self.cfg.max_snapshots
+        node = (s.time // every) % self.topo.n
+        fire = (s.time % every == 0) & (s.time > 0) & (s.next_sid < S)
+        if self.marker_mode == "ring":
+            return lax.cond(fire,
+                            lambda s: self._inject_snapshot(s, node),
+                            lambda s: s, s)
+        mask = fire & (jnp.arange(self.topo.n, dtype=_i32) == node)
+        return lax.cond(fire, lambda s: self._bulk_snapshots(s, mask),
+                        lambda s: s, s)
+
+    def _supervise(self, s: DenseState) -> DenseState:
+        """The per-tick supervisor step, run at tick start (after the
+        time increment and crash restarts, before delivery selection) in
+        the cascade, wave and sync ticks: the daemon, then the timeout
+        scan + re-initiation. Re-initiated markers carry receive times
+        > time, so the tick's delivery selection is untouched."""
+        if self.cfg.snapshot_every:
+            s = self._sup_daemon(s)
+        if self.cfg.snapshot_timeout:
+            s, retry = self._sup_scan(s)
+            if self.marker_mode == "ring":
+                s = self._sup_reinitiate_ring(s, retry)
+            else:
+                s = self._sup_reinitiate_split(s, retry)
+        return s
+
+    def _stamp_done(self, s: DenseState) -> DenseState:
+        """Record each snapshot's completion tick (once, at the tick it
+        reached all nodes) — the recovery-line-age metric's source
+        (utils/metrics.snapshot_lifecycle). Traced unconditionally: one
+        [S] where per tick, identical across supervised and unsupervised
+        kernels."""
+        newly = (s.started & (s.completed >= self.topo.n)
+                 & (s.snap_done_time < 0))
+        return s._replace(
+            snap_done_time=jnp.where(newly, s.time, s.snap_done_time))
 
     # ---- queue primitives ------------------------------------------------
 
@@ -629,10 +852,13 @@ class TickKernel:
 
     def _push_marker(self, s: DenseState, e, sid) -> DenseState:
         """Scalar marker enqueue, routed by marker_mode: into the ring
-        (exact scheduler) or the [S, E] pending planes (split mode). One
-        delay draw either way, so the sampler stream is mode-invariant."""
+        (exact scheduler; payload = the epoch-tagged word when the
+        supervisor is armed) or the [S, E] pending planes (split mode,
+        where the plane index is the id and aborts clear in place — no
+        epoch storage needed). One delay draw either way, so the sampler
+        stream is mode-invariant."""
         if self.marker_mode == "ring":
-            return self._push(s, e, True, sid)
+            return self._push(s, e, True, self._marker_payload(s, sid))
         rtime, dstate = self.delay.draw(s.delay_state, s.time)
         return s._replace(
             m_pending=s.m_pending.at[sid, e].set(True),
@@ -718,7 +944,7 @@ class TickKernel:
             True, mode="drop")
         rt_e = jnp.zeros(self.topo.e, _i32).at[tgt].set(rts_k, mode="drop")
         return self._append_rows(s, active, rt_e, True,
-                                 jnp.asarray(sid, _i32))
+                                 self._marker_payload(s, sid))
 
     def _finalize_check(self, s: DenseState, sid, node) -> DenseState:
         """finalizeSnapshot + NotifyCompletedSnapshot when no links remain
@@ -747,14 +973,19 @@ class TickKernel:
             return self._broadcast_markers(s, dst, sid)
 
         def repeat(s):
-            # a repeat marker always finds the channel recording (each id
-            # crosses an edge once; the excluded channel consumed the FIRST
-            # marker) — close the window at the current append counter
+            # close the window at the current append counter. Without
+            # marker faults a repeat always finds the channel recording
+            # (each id crosses an edge once; the excluded channel consumed
+            # the FIRST marker); a DUPLICATED marker can re-arrive after
+            # the close, so the rem decrement and window close are gated
+            # on the channel actually still recording
+            was = s.recording[sid, e]
             return s._replace(
                 recording=s.recording.at[sid, e].set(False),
-                rem=s.rem.at[sid, dst].add(-1),
+                rem=s.rem.at[sid, dst].add(-was.astype(_i32)),
                 rec_end=s.rec_end.at[sid, e].set(
-                    s.rec_cnt[e].astype(s.rec_end.dtype)),
+                    jnp.where(was, s.rec_cnt[e].astype(s.rec_end.dtype),
+                              s.rec_end[sid, e])),
             )
 
         s = lax.cond(~s.has_local[sid, dst], first, repeat, s)
@@ -814,7 +1045,7 @@ class TickKernel:
             return s, None
 
         s, _ = lax.scan(per_source, s, jnp.arange(self.topo.n, dtype=_i32))
-        return s
+        return self._stamp_done(s)
 
     # ---- shared tick-start machinery for the vectorized exact forms -----
 
@@ -831,10 +1062,13 @@ class TickKernel:
         head_rt, head_mk, head_data = self._head_fields(s)
         elig = (s.q_len > 0) & (head_rt <= s.time)
         if self.faults is not None:
-            # delivery-side fault gates: jitter stalls the front, a down
-            # destination receives nothing (messages wait, lossless)
+            # delivery-side fault gates: jitter stalls the front (the
+            # marker-plane jitter program stalls marker fronts on top),
+            # a down destination receives nothing (messages wait,
+            # lossless)
             _, _, jit_e, _ = self._fault_edge_masks(s)
-            s, elig = self._fault_gate_elig(s, elig, jit_e)
+            _, _, mjit_e, _ = self._fault_marker_masks(s)
+            s, elig = self._fault_gate_elig(s, elig, jit_e, mjit_e, head_mk)
         # first eligible edge per source in dest order (same O(E) prefix-
         # count formulation as _sync_tick; edges are per-source contiguous)
         elig_i = elig.astype(_i32)
@@ -912,20 +1146,29 @@ class TickKernel:
         bit-identical. Size C with SimConfig.for_workload as always.
         """
         s = s._replace(time=s.time + 1)
-        dup_pend = dup_rt = None
+        dup_pend = dup_rt = mk_dup = mdup_rt = None
         if self.faults is not None:
             s = self._fault_restart(s)
+        if self._sup:
+            s = self._supervise(s)
         s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
         if self.faults is not None:
             # drop/dup act on the popped token set; the marker fold below
             # never sees a dropped token (it vanished on the wire), and
             # duplicates re-enqueue after the fold so this tick's selection
-            # is untouched (their receive times are > time anyway)
+            # is untouched (their receive times are > time anyway). The
+            # marker-plane program does the same to the popped markers —
+            # a dropped marker is exactly the control-plane loss the
+            # supervisor's timeout recovers from
             drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
             s, tok_pend, dup_pend = self._fault_split_tokens(
                 s, tok_pend, head_data, drop_e, dup_e)
+            s, mk_pend, mk_dup, mdup_rt = self._fault_split_markers(
+                s, mk_pend)
+        # superseded-epoch markers die here (counted), and sid_e becomes
+        # the decoded slot id (the raw payload when unsupervised)
+        s, mk_pend, sid_e = self._reject_stale(s, mk_pend, head_data)
         amt_e = jnp.where(tok_pend, head_data, 0)
-        sid_e = head_data                       # marker payload: snapshot id
         rows = self._rows_e
 
         def credit(s, mask):
@@ -964,11 +1207,16 @@ class TickKernel:
             self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
         s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
         if self.faults is not None:
-            # duplicated tokens re-enter their channel at the tail, receive
-            # times from the fault stream (the delay sampler never sees a
-            # fault), overflow flagged by the shared append primitive
-            s = self._append_rows(s, dup_pend, dup_rt, False, head_data)
-        return s
+            # duplicated tokens AND markers re-enter their channel at the
+            # tail (disjoint edge sets: an edge delivered one or the
+            # other), receive times from the fault streams (the delay
+            # sampler never sees a fault), marker duplicates keeping their
+            # epoch-tagged payload, overflow flagged by the shared append
+            # primitive
+            s = self._append_rows(s, dup_pend | mk_dup,
+                                  jnp.where(mk_dup, mdup_rt, dup_rt),
+                                  mk_dup, head_data)
+        return self._stamp_done(s)
 
     # ---- the wave tick: the cascade with cross-destination parallelism --
 
@@ -1015,18 +1263,23 @@ class TickKernel:
         C = self.cfg.queue_capacity
         S, E = self.cfg.max_snapshots, self.topo.e
         s = s._replace(time=s.time + 1)
-        time = s.time
-        dup_pend = dup_rt = None
+        dup_pend = dup_rt = mk_dup = mdup_rt = None
         if self.faults is not None:
             s = self._fault_restart(s)
+        if self._sup:
+            s = self._supervise(s)
+        time = s.time
         s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
         if self.faults is not None:
-            # same drop/dup discipline as the cascade (one shared hook set)
+            # same drop/dup discipline as the cascade (one shared hook
+            # set), token and marker planes alike
             drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
             s, tok_pend, dup_pend = self._fault_split_tokens(
                 s, tok_pend, head_data, drop_e, dup_e)
+            s, mk_pend, mk_dup, mdup_rt = self._fault_split_markers(
+                s, mk_pend)
+        s, mk_pend, sid_e = self._reject_stale(s, mk_pend, head_data)
         amt_e = jnp.where(tok_pend, head_data, 0)
-        sid_e = head_data                       # marker payload: snapshot id
         rank_e = self._rows_e                   # fold rank == edge index
         onehot_se = jnp.arange(S, dtype=_i32)[:, None] == sid_e[None, :]
 
@@ -1097,7 +1350,11 @@ class TickKernel:
             # segment sums at large N — unlike the stacked rank/base sums
             # above, whose values exceed the f32-exact range
             rep_se = onehot_se & (wm & ~first_e)[None, :]          # [S, E]
-            rep_sn = self._sum_by_dst(rep_se, amounts=False)       # [S, N]
+            # a DUPLICATED repeat can arrive after its channel's window
+            # already closed — only live closes decrement rem / stamp
+            # rec_end (the cascade's `was` gate, vectorized)
+            rep_live = rep_se & s.recording
+            rep_sn = self._sum_by_dst(rep_live, amounts=False)     # [S, N]
             first_sn = (sid_rows == wsid_n[None, :]) & wfirst_n[None, :]
             # first markers: CreateLocalSnapshot excluding the marker's
             # link (node.go:58-84), windows opened at the counter each edge
@@ -1114,7 +1371,7 @@ class TickKernel:
             s = s._replace(
                 recording=(s.recording | open_se) & ~rep_se,
                 rec_end=jnp.where(
-                    rep_se, s.rec_cnt[None, :].astype(s.rec_end.dtype),
+                    rep_live, s.rec_cnt[None, :].astype(s.rec_end.dtype),
                     s.rec_end),
                 rec_start=jnp.where(
                     open_se, cnt_open[None, :].astype(s.rec_start.dtype),
@@ -1156,8 +1413,10 @@ class TickKernel:
             self._rec_dtype, self._rec_limit, self.cfg.max_recorded)
         s = s._replace(log_amt=log, rec_cnt=cnt, error=s.error | err)
         if self.faults is not None:
-            s = self._append_rows(s, dup_pend, dup_rt, False, head_data)
-        return s
+            s = self._append_rows(s, dup_pend | mk_dup,
+                                  jnp.where(mk_dup, mdup_rt, dup_rt),
+                                  mk_dup, head_data)
+        return self._stamp_done(s)
 
     # ---- the synchronous tick (fast-path scheduler) ----------------------
 
@@ -1192,6 +1451,8 @@ class TickKernel:
         s = s._replace(time=time)
         if self.faults is not None:
             s = self._fault_restart(s)
+        if self._sup:
+            s = self._supervise(s)
         BIG = jnp.int32(jnp.iinfo(jnp.int32).max)
 
         # ---- channel fronts: token head via queue_engine-addressed reads
@@ -1218,10 +1479,13 @@ class TickKernel:
         dup_e_mask = dup_rt = None
         if self.faults is not None:
             # delivery-side gates first (jitter stalls the merged front —
-            # marker or token alike; a down destination receives nothing),
-            # then the drop/dup program on the tokens that do deliver
+            # marker or token alike; the marker-plane jitter program
+            # stalls marker fronts on top; a down destination receives
+            # nothing), then the drop/dup programs on what does deliver
             drop_e, dup_e_mask, jit_e, dup_rt = self._fault_edge_masks(s)
-            s, elig_e = self._fault_gate_elig(s, elig_e, jit_e)
+            mdrop_e, mdup_e, mjit_e, mdup_rt = self._fault_marker_masks(s)
+            s, elig_e = self._fault_gate_elig(s, elig_e, jit_e, mjit_e,
+                                              front_is_marker)
         # at most one delivery per source: first eligible edge in dest
         # order, via an exclusive prefix count re-based at each source's
         # first edge (edges are per-source contiguous) — O(E)
@@ -1273,8 +1537,21 @@ class TickKernel:
         # mk_se needs no payload decode. With k simultaneous markers for
         # one (slot, node) all k channels are excluded from recording
         # (CreateLocalSnapshot, node.go:58-84).
+        mk_all_se = m_is_front & jnp.expand_dims(mk_e, -2)         # [S, E]
+        # every delivering front is CONSUMED from the pending planes —
+        # including ones the marker-plane adversary then drops on the wire
+        # (the loss that stalls the snapshot until the supervisor's
+        # timeout); only the surviving set is handled below
+        s = s._replace(m_pending=s.m_pending & ~mk_all_se)
+        mk_dup_e = None
+        if self.faults is not None:
+            mk_drop_e = mk_e & mdrop_e
+            mk_dup_e = mk_e & mdup_e & ~mk_drop_e
+            s = s._replace(fault_counts=s.fault_counts.at[FC_MDROP].add(
+                jnp.sum(mk_drop_e, dtype=_i32)).at[FC_MDUP].add(
+                jnp.sum(mk_dup_e, dtype=_i32)))
+            mk_e = mk_e & ~mk_drop_e
         mk_se = m_is_front & jnp.expand_dims(mk_e, -2)             # [S, E]
-        s = s._replace(m_pending=s.m_pending & ~mk_se)
         arrivals = self._sum_by_dst(mk_se, amounts=False)          # [S, N]
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
@@ -1282,8 +1559,16 @@ class TickKernel:
         stopped = mk_se & s.recording                              # [S, E]
         started_se = created_dst_se & ~mk_se                       # [S, E]
         recording = (s.recording | created_dst_se) & ~mk_se
+        if self.faults is not None:
+            # a DUPLICATED marker can re-arrive on a channel whose window
+            # already closed — only live closes may decrement rem (the
+            # fault-free path keeps arrivals: without dups every arrival
+            # at a has_local node finds its channel recording)
+            closed_sn = self._sum_by_dst(stopped, amounts=False)   # [S, N]
+        else:
+            closed_sn = arrivals
         rem = jnp.where(created, self._in_degree[None, :] - arrivals,
-                        s.rem - jnp.where(had, arrivals, 0))
+                        s.rem - jnp.where(had, closed_sn, 0))
         has_local = had | created
         # window open/close at the POST-append counters (tokens deliver
         # before markers within the tick, and a delivering edge carries
@@ -1301,13 +1586,28 @@ class TickKernel:
         # planes — no ring content is touched
         push_se = self._spread_src(created)                        # [S, E]
         s = self._push_markers_split(s, push_se)
+        if self.faults is not None:
+            # duplicated markers re-arm their pending-plane entry with a
+            # fault-stream receive time and a fresh merge key (at most one
+            # front per edge delivered, so at most one dup per edge; the
+            # re-broadcast above never targets the same (slot, edge) —
+            # its source already had has_local when it pushed this front)
+            dup_se = m_is_front & jnp.expand_dims(mk_dup_e, -2)    # [S, E]
+            key_e = s.tok_pushed * self._keymult + s.mk_cnt
+            s = s._replace(
+                m_pending=s.m_pending | dup_se,
+                m_rtime=jnp.where(dup_se, jnp.expand_dims(mdup_rt, -2),
+                                  s.m_rtime),
+                m_key=jnp.where(dup_se, jnp.expand_dims(key_e, -2),
+                                s.m_key),
+                mk_cnt=s.mk_cnt + mk_dup_e.astype(_i32))
 
         # ---- finalize (node.go:165-170)
         fire = has_local & (rem == 0) & ~s.done_local
-        return s._replace(
+        return self._stamp_done(s._replace(
             done_local=s.done_local | fire,
             completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32),
-        )
+        ))
 
     # ---- fused multi-tick dispatch (the megatick engine) -----------------
 
@@ -1328,10 +1628,20 @@ class TickKernel:
         A crash-capable fault adversary voids the proof: a lossy restart
         mutates balances (and counts events) on a drained lane too, so
         empty rings no longer make a tick the identity — quiescence is
-        statically False then and every tick runs for real."""
+        statically False then and every tick runs for real. The snapshot
+        supervisor narrows it the same way: the snapshot_every daemon can
+        initiate on any tick (never quiescent), and with snapshot_timeout
+        armed a lane with a PENDING snapshot and empty rings is exactly a
+        stalled attempt that must keep ticking to reach its deadline —
+        only pending-free lanes fast-forward."""
         if self.faults is not None and self.faults.crashes:
             return jnp.zeros(s.time.shape, bool)
-        return ~jnp.any(s.q_len > 0, axis=-1)
+        if self.cfg.snapshot_every:
+            return jnp.zeros(s.time.shape, bool)
+        quiet = ~jnp.any(s.q_len > 0, axis=-1)
+        if self.cfg.snapshot_timeout:
+            quiet = quiet & ~self._pending(s)
+        return quiet
 
     def _run_ticks(self, s: DenseState, n) -> DenseState:
         """n ticks under one dispatch; n is a traced i32 so every distinct
@@ -1436,6 +1746,15 @@ class TickKernel:
         s = s._replace(next_sid=s.next_sid + 1,
                        started=s.started.at[sid].set(True),
                        error=err)
+        if self._sup:
+            # remember the initiator (the supervisor's re-initiation
+            # target) and arm the first attempt's deadline
+            s = s._replace(
+                snap_initiator=s.snap_initiator.at[sid].set(
+                    jnp.asarray(node, _i32)))
+            if self.cfg.snapshot_timeout:
+                s = s._replace(snap_deadline=s.snap_deadline.at[sid].set(
+                    s.time + self.cfg.snapshot_timeout))
         s = self._create_local(s, sid, node, jnp.int32(-1))
         return self._broadcast_markers(s, node, sid)
 
@@ -1526,12 +1845,25 @@ class TickKernel:
             started=s.started | jnp.any(created, axis=1),
             error=err,
         )
+        if self._sup:
+            any_c = jnp.any(created, axis=-1)
+            init_n = jnp.argmax(created, axis=-1).astype(_i32)
+            s = s._replace(snap_initiator=jnp.where(any_c, init_n,
+                                                    s.snap_initiator))
+            if self.cfg.snapshot_timeout:
+                s = s._replace(snap_deadline=jnp.where(
+                    any_c, s.time + self.cfg.snapshot_timeout,
+                    s.snap_deadline))
         return self._create_and_broadcast(s, created)
 
     # ---- drain (test_common.go:124-137) ---------------------------------
 
     def _pending(self, s: DenseState):
-        return jnp.any(s.started & (s.completed < self.topo.n))
+        # a supervisor-failed slot (retries exhausted, ERR_SNAPSHOT_TIMEOUT
+        # raised) no longer gates the drain — without the exclusion a dead
+        # attempt would grind the loop to ERR_TICK_LIMIT on top
+        return jnp.any(s.started & ~s.snap_failed
+                       & (s.completed < self.topo.n))
 
     def _drain_and_flush_with(self, s: DenseState, tick_fn,
                               megatick: int = 1) -> DenseState:

@@ -369,10 +369,13 @@ class BatchedRunner:
                 st = st._replace(
                     tokens=jnp.broadcast_to(
                         tokens0, (self.batch,) + tokens0.shape),
-                    # the one non-zero init beside tokens: "no protected
-                    # window yet" is encoded as int32 max (state.init_state)
+                    # the non-zero inits beside tokens (state.init_state):
+                    # "no protected window yet" = int32 max, and the
+                    # supervisor's "unset" initiator/completion-tick = -1
                     min_prot=jnp.full_like(st.min_prot,
-                                           jnp.iinfo(jnp.int32).max))
+                                           jnp.iinfo(jnp.int32).max),
+                    snap_initiator=jnp.full_like(st.snap_initiator, -1),
+                    snap_done_time=jnp.full_like(st.snap_done_time, -1))
                 if self.faults is not None:
                     st = st._replace(
                         fault_key=self.faults.init_batch_state(self.batch))
@@ -634,7 +637,10 @@ class BatchedRunner:
     @staticmethod
     def summarize(state: DenseState) -> dict:
         from chandy_lamport_tpu.core.state import decode_error_bits
-        from chandy_lamport_tpu.utils.metrics import or_reduce
+        from chandy_lamport_tpu.utils.metrics import (
+            or_reduce,
+            snapshot_lifecycle,
+        )
 
         bits = int(or_reduce(state.error))
         fc = jnp.sum(state.fault_counts, axis=0)
@@ -657,6 +663,15 @@ class BatchedRunner:
             # adversary books (models/faults.py): events per class + the
             # injected token delta conservation_delta subtracts
             "fault_events": {"drops": int(fc[0]), "dups": int(fc[1]),
-                             "jitters": int(fc[2]), "crashes": int(fc[3])},
+                             "jitters": int(fc[2]), "crashes": int(fc[3]),
+                             "marker_drops": int(fc[4]),
+                             "marker_dups": int(fc[5]),
+                             "marker_jitters": int(fc[6])},
             "fault_skew": int(jnp.sum(state.fault_skew)),
+            # supervisor lifecycle (utils/metrics.snapshot_lifecycle):
+            # initiated / completed / retried / failed / aborted /
+            # stale_markers + recovery-line age, summed over lanes
+            "snapshot_lifecycle": {
+                k: int(v) for k, v in snapshot_lifecycle(
+                    state, state.has_local.shape[-1]).items()},
         }

@@ -46,6 +46,7 @@ from chandy_lamport_tpu.core.state import (
     ERR_QUEUE_OVERFLOW,
     ERR_RECORD_OVERFLOW,
     ERR_SNAPSHOT_OVERFLOW,
+    ERR_SNAPSHOT_TIMEOUT,
     ERR_TICK_LIMIT,
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
@@ -143,6 +144,18 @@ class ShardedState(NamedTuple):
     rec_start: Any   # window dtype [P, S, Em] (SimConfig.window_dtype)
     rec_end: Any     # window dtype [P, S, Em]
     completed: Any   # i32 [S] (replicated)
+    # snapshot-supervisor state (SimConfig.snapshot_timeout/_every) — all
+    # replicated: the timeout scan / abort decision is global, so every
+    # shard computes it identically and the gating conds stay SPMD-uniform
+    # (the split representation clears its pending planes on abort, so no
+    # epoch storage or stale accounting is needed here; marker-fault
+    # INJECTION stays a dense/batched-path feature)
+    snap_epoch: Any      # i32 [S] (replicated)
+    snap_deadline: Any   # i32 [S] (replicated; 0 = unarmed)
+    snap_retries: Any    # i32 [S] (replicated)
+    snap_initiator: Any  # i32 [S] (replicated; -1 = unset)
+    snap_failed: Any     # bool [S] (replicated)
+    snap_done_time: Any  # i32 [S] (replicated; -1 until completed)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
 
@@ -240,6 +253,11 @@ class GraphShardedRunner:
         self.check_every = int(check_every)
         self.quarantine = bool(quarantine)
         self.queue_engine = resolve_queue_engine(queue_engine)
+        # snapshot supervisor (SimConfig.snapshot_timeout/_every): the
+        # sharded twin of TickKernel._supervise — replicated scan/abort
+        # state, shard-local plane clears, cond-gated re-initiation
+        self._sup = bool(self.config.snapshot_timeout > 0
+                         or self.config.snapshot_every > 0)
         self.max_delay = fixed_delay if fixed_delay is not None else max_delay
         self.fixed_delay = fixed_delay
         if self.config.max_delay != self.max_delay:
@@ -284,6 +302,9 @@ class GraphShardedRunner:
             rec_cnt=spec_sharded,
             min_prot=spec_sharded, log_amt=spec_sharded,
             rec_start=spec_sharded, rec_end=spec_sharded, completed=spec_rep,
+            snap_epoch=spec_rep, snap_deadline=spec_rep,
+            snap_retries=spec_rep, snap_initiator=spec_rep,
+            snap_failed=spec_rep, snap_done_time=spec_rep,
             delay_key=spec_sharded, error=spec_rep)
         self._state_specs = state_specs
 
@@ -344,6 +365,12 @@ class GraphShardedRunner:
             rec_start=np.zeros((p, s, em), np.dtype(cfg.window_dtype)),
             rec_end=np.zeros((p, s, em), np.dtype(cfg.window_dtype)),
             completed=np.zeros(s, np.int32),
+            snap_epoch=np.zeros(s, np.int32),
+            snap_deadline=np.zeros(s, np.int32),
+            snap_retries=np.zeros(s, np.int32),
+            snap_initiator=np.full(s, -1, np.int32),
+            snap_failed=np.zeros(s, np.bool_),
+            snap_done_time=np.full(s, -1, np.int32),
             delay_key=keys,
             error=np.int32(0),
         )
@@ -405,7 +432,7 @@ class GraphShardedRunner:
         smaller bit and decode_errors would mislabel the cause. Per-bit
         psum>0 preserves every flag."""
         mask = jnp.asarray(mask, _i32)
-        shifts = jnp.arange(8, dtype=_i32)  # 8 ERR_ bits defined (state.py)
+        shifts = jnp.arange(9, dtype=_i32)  # 9 ERR_ bits defined (state.py)
         bits = (mask[..., None] >> shifts) & 1
         any_bit = lax.psum(bits, self.axis) > 0
         return jnp.sum(any_bit.astype(_i32) << shifts, axis=-1, dtype=_i32)
@@ -555,6 +582,17 @@ class GraphShardedRunner:
         s = s._replace(next_sid=s.next_sid + count,
                        started=s.started | jnp.any(created, axis=1),
                        error=s.error | err.astype(_i32))
+        if self._sup:
+            # remember initiators + arm deadlines (replicated math — the
+            # created matrix is replicated, so every shard agrees)
+            any_c = jnp.any(created, axis=-1)
+            init_n = jnp.argmax(created, axis=-1).astype(_i32)
+            s = s._replace(snap_initiator=jnp.where(any_c, init_n,
+                                                    s.snap_initiator))
+            if self.config.snapshot_timeout:
+                s = s._replace(snap_deadline=jnp.where(
+                    any_c, s.time + self.config.snapshot_timeout,
+                    s.snap_deadline))
         return self._create_and_broadcast(s, st, created)
 
     def _inject_send_local(self, s: ShardedState, st: ShardedTopology,
@@ -597,12 +635,69 @@ class GraphShardedRunner:
                 * ERR_VALUE_OVERFLOW),
         )
 
+    def _supervise(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
+        """The sharded snapshot supervisor (TickKernel._supervise's twin):
+        daemon initiation, then the timeout scan — abort (shard-local
+        plane clears driven by the replicated timed-out mask), retry
+        re-initiation through the collective create+broadcast under a
+        replicated cond, or ERR_SNAPSHOT_TIMEOUT on exhaustion. Every
+        predicate is replicated, so the conds (whose true branches carry
+        collectives) stay SPMD-uniform."""
+        cfg = self.config
+        S, n = cfg.max_snapshots, self.topo.n
+        if cfg.snapshot_every:
+            every = cfg.snapshot_every
+            node = (s.time // every) % n
+            fire = (s.time % every == 0) & (s.time > 0) & (s.next_sid < S)
+            mask = fire & (jnp.arange(n, dtype=_i32) == node)
+            s = lax.cond(fire,
+                         lambda s: self._bulk_snapshots(s, st, mask),
+                         lambda s: s, s)
+        if not cfg.snapshot_timeout:
+            return s
+        timed_out = (s.started & ~s.snap_failed & (s.completed < n)
+                     & (s.snap_deadline > 0) & (s.time >= s.snap_deadline))
+        can_retry = timed_out & (s.snap_retries
+                                 < jnp.int32(cfg.snapshot_retries))
+        failed = timed_out & ~can_retry
+        t_b = timed_out[:, None]
+        new_retries = s.snap_retries + can_retry.astype(_i32)
+        backoff = jnp.left_shift(jnp.int32(max(cfg.snapshot_timeout, 1)),
+                                 jnp.minimum(new_retries, 4))
+        s = s._replace(
+            has_local=s.has_local & ~t_b,
+            done_local=s.done_local & ~t_b,
+            frozen=jnp.where(t_b, 0, s.frozen),
+            rem=jnp.where(t_b, 0, s.rem),
+            recording=s.recording & ~t_b,
+            rec_start=jnp.where(t_b, jnp.zeros_like(s.rec_start),
+                                s.rec_start),
+            rec_end=jnp.where(t_b, jnp.zeros_like(s.rec_end), s.rec_end),
+            completed=jnp.where(timed_out, 0, s.completed),
+            m_pending=s.m_pending & ~t_b,
+            snap_epoch=s.snap_epoch + timed_out.astype(_i32),
+            snap_retries=new_retries,
+            snap_failed=s.snap_failed | failed,
+            snap_deadline=jnp.where(can_retry, s.time + backoff,
+                                    jnp.where(failed, 0, s.snap_deadline)),
+            error=s.error | jnp.where(jnp.any(failed),
+                                      ERR_SNAPSHOT_TIMEOUT, 0).astype(_i32),
+        )
+        created = can_retry[:, None] & (
+            jnp.arange(n, dtype=_i32)
+            == jnp.clip(s.snap_initiator, 0, n - 1)[:, None])  # [S, N] rep
+        return lax.cond(jnp.any(can_retry),
+                        lambda s: self._create_and_broadcast(s, st, created),
+                        lambda s: s, s)
+
     def _sync_tick(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
         """The sync scheduler with the cross-shard steps as collectives."""
         cfg = self.config
         C, S, M = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
         time = s.time + 1
         s = s._replace(time=time)
+        if self._sup:
+            s = self._supervise(s, st)
 
         # channel fronts under the split representation (mirrors
         # TickKernel._sync_tick): token head via queue_engine-addressed
@@ -681,13 +776,23 @@ class GraphShardedRunner:
 
         fire = s.has_local & (s.rem == 0) & ~s.done_local
         fired = lax.psum(jnp.sum(fire, axis=-1, dtype=_i32), self.axis)  # [S]
+        completed = s.completed + fired
+        # completion-tick stamp (recovery-line age metric) — replicated,
+        # every shard computes the same value
+        newly = (s.started & (completed >= self.topo.n)
+                 & (s.snap_done_time < 0))
         return s._replace(done_local=s.done_local | fire,
-                          completed=s.completed + fired)
+                          completed=completed,
+                          snap_done_time=jnp.where(newly, s.time,
+                                                   s.snap_done_time))
 
     # -- program execution -------------------------------------------------
 
     def _pending(self, s: ShardedState):
-        return jnp.any(s.started & (s.completed < self.topo.n))
+        # supervisor-failed slots (ERR_SNAPSHOT_TIMEOUT) no longer gate the
+        # drain — same exclusion as TickKernel._pending
+        return jnp.any(s.started & ~s.snap_failed
+                       & (s.completed < self.topo.n))
 
     def _check_conservation(self, s: ShardedState) -> ShardedState:
         """The sharded twin of BatchedRunner._check_conservation: one psum
@@ -983,7 +1088,17 @@ class GraphShardedRunner:
             # docstring); the reassembled dense state is fault-clean
             fault_key=np.uint32(0),
             fault_skew=np.int32(0),
-            fault_counts=np.zeros(4, np.int32),
+            fault_counts=np.zeros(7, np.int32),
+            # supervisor leaves carry over replicated; the split
+            # representation clears pending planes on abort, so no stale
+            # markers can exist to tally
+            snap_epoch=np.asarray(h.snap_epoch),
+            snap_deadline=np.asarray(h.snap_deadline),
+            snap_retries=np.asarray(h.snap_retries),
+            snap_initiator=np.asarray(h.snap_initiator),
+            snap_failed=np.asarray(h.snap_failed),
+            snap_done_time=np.asarray(h.snap_done_time),
+            stale_markers=np.int32(0),
             error=np.asarray(h.error),
         )
 

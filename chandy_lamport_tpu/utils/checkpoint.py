@@ -52,7 +52,18 @@ from chandy_lamport_tpu.core.state import DenseState
 #       core/state.py) join the carry, and writes became atomic
 #       (tmp-then-os.replace); a version-3 checkpoint is three leaves short
 #       and errors here rather than misalign every leaf after delay_state
-_FORMAT_VERSION = 4
+#   5 — PR-4 snapshot-supervisor leaves (snap_epoch/snap_deadline/
+#       snap_retries/snap_initiator/snap_failed/snap_done_time +
+#       stale_markers, core/state.py) join the carry and fault_counts
+#       widens to [7] (marker-plane classes); a version-4 checkpoint is
+#       seven leaves short with a mis-shaped fault_counts, so it errors
+#       here rather than misdecode
+_FORMAT_VERSION = 5
+# every layout change so far has been breaking (leaves added or reshaped),
+# so exactly one version is live; kept as a range so a future
+# backward-compatible revision can widen the floor without touching the
+# error message
+_MIN_SUPPORTED_VERSION = _FORMAT_VERSION
 
 
 class CheckpointError(ValueError):
@@ -106,12 +117,13 @@ def load_state(path: str, like: DenseState) -> Tuple[DenseState, dict]:
                     f"checkpoint {path}: no __header__ entry — truncated "
                     f"write or not a clsim checkpoint")
             header = json.loads(bytes(z["__header__"]).decode())
-            if header["format_version"] != _FORMAT_VERSION:
+            version = header["format_version"]
+            if not _MIN_SUPPORTED_VERSION <= version <= _FORMAT_VERSION:
                 raise CheckpointError(
                     f"checkpoint {path}: unsupported format version "
-                    f"{header['format_version']} (this build reads "
-                    f"{_FORMAT_VERSION}; see version history in "
-                    f"utils/checkpoint.py)")
+                    f"{version} (this build reads the supported version "
+                    f"range v{_MIN_SUPPORTED_VERSION}..v{_FORMAT_VERSION}; "
+                    f"see the version history in utils/checkpoint.py)")
             leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
     except CheckpointError:
         raise

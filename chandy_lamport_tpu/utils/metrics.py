@@ -61,6 +61,35 @@ def conservation_delta(state: DenseState, cfg: SimConfig,
             - jnp.sum(state.fault_skew))
 
 
+def snapshot_lifecycle(state, num_nodes: int) -> Dict[str, jnp.ndarray]:
+    """Snapshot-supervisor lifecycle counters (works for DenseState with
+    any leading batching AND for ShardedState, whose supervisor leaves are
+    replicated): attempts initiated / completed / retried / failed /
+    aborted (= retried + failed — every abort either re-initiates or
+    fails), stale-epoch marker rejections, and the recovery-line age —
+    ticks since the NEWEST completed snapshot (the rollback line a lossy
+    crash would restore from; models/faults.py), -1 when no lane has a
+    completed snapshot yet. ``recovery_line_age_max`` is the worst lane's
+    age, the number an operator alarms on."""
+    started = state.started
+    complete = started & (state.completed >= num_nodes)
+    done_t = jnp.where(complete, state.snap_done_time, -1)
+    any_done = jnp.any(complete, axis=-1)
+    age = jnp.where(any_done, state.time - jnp.max(done_t, axis=-1), -1)
+    retried = jnp.sum(state.snap_retries)
+    failed = jnp.sum(state.snap_failed)
+    return {
+        "initiated": jnp.sum(started),
+        "completed": jnp.sum(complete),
+        "retried": retried,
+        "failed": failed,
+        "aborted": retried + failed,
+        "stale_markers": jnp.sum(
+            jnp.asarray(getattr(state, "stale_markers", 0))),
+        "recovery_line_age_max": jnp.max(age),
+    }
+
+
 def progress_counters(state: DenseState, cfg: SimConfig,
                       num_nodes: int) -> Dict[str, jnp.ndarray]:
     """Aggregate lifecycle counters; under a sharded batch axis these
@@ -98,7 +127,7 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     """Per-instance HBM bytes of a DenseState (excluding delay state):
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
-    footprint = 8·E·C + (24 + rec·L)·E + 4·N + S·(1 + 10·N + (10+2·win)·E)
+    footprint = 8·E·C + (24 + rec·L)·E + 4·N + S·(22 + 10·N + (10+2·win)·E)
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16),
     win = itemsize of SimConfig.window_dtype (4 default, 2 for uint16),
     and L = cfg.max_recorded (shared per-edge log slots). The 8·E·C term
@@ -122,11 +151,15 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     # per-edge recording log: rec_cnt/min_prot + log_amt[L, E]
     rec_log = e * (4 + 4) + rec * m * e
     # per slot: started + [S,N] planes + recording + window counters
-    # (start/end) + split-marker planes m_pending/m_rtime/m_key
+    # (start/end) + split-marker planes m_pending/m_rtime/m_key + the
+    # supervisor's epoch/deadline/retries/initiator/done_time (i32) and
+    # failed (bool) leaves
     snaps = s * (1 + n * (1 + 4 + 4 + 1)
-                 + e * (1 + win * 2) + e * (1 + 4 + 4))
-    # time/next_sid/error + fault_key/fault_skew/fault_counts[4], completed
-    scalars = 4 * 3 + 4 * 6 + s * 4
+                 + e * (1 + win * 2) + e * (1 + 4 + 4)
+                 + 5 * 4 + 1)
+    # time/next_sid/error + fault_key/fault_skew/fault_counts[7] +
+    # stale_markers, completed
+    scalars = 4 * 3 + 4 * 10 + s * 4
     return queues + nodes + rec_log + snaps + scalars
 
 

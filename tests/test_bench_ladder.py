@@ -144,6 +144,31 @@ def test_worker_row_round_trips_queue_engine(engine, capsys):
     assert row["metric"] == "node_ticks_per_sec_per_chip"
     assert row["queue_engine"] == engine
     assert row["value"] > 0
+    # snapshot-lifecycle stats round-trip on EVERY row (PR-4 satellite):
+    # a clean supervised-off run reports zero churn, a live recovery line,
+    # and no supervisor knobs (they only stamp the row when armed)
+    lc = row["snapshot_lifecycle"]
+    assert lc["completed"] == lc["initiated"] > 0
+    assert lc["retried"] == lc["failed"] == lc["stale_markers"] == 0
+    assert row["recovery_line_age"] == lc["recovery_line_age_max"] >= 0
+    assert "snapshot_timeout" not in row
+
+
+@pytest.mark.slow
+def test_worker_row_round_trips_supervisor_knobs(capsys):
+    """An armed-supervisor worker run stamps its knobs on the row, so a
+    ladder rung measured under the supervisor can never masquerade as an
+    unsupervised number (tier-1 already round-trips the lifecycle fields
+    in the queue-engine rows above; the armed run rides full passes)."""
+    rc = bench.main(["--worker", "--nodes", "16", "--batch", "2",
+                     "--phases", "3", "--snapshots", "2", "--repeats", "1",
+                     "--snapshot-timeout", "64"])
+    assert rc == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["snapshot_timeout"] == 64
+    assert row["snapshot_retries"] == 3
+    lc = row["snapshot_lifecycle"]
+    assert lc["completed"] == lc["initiated"] > 0 and lc["failed"] == 0
 
 
 def test_dead_probe_path_tries_tpu_blind_then_cpu(monkeypatch, capsys):

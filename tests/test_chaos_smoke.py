@@ -22,9 +22,11 @@ def test_chaos_smoke_battery_green():
     verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
     assert verdict["ok"] is True
     names = [r["scenario"] for r in verdict["scenarios"]]
-    # each fault class injected at least once, plus both crash outcomes
+    # each fault class injected at least once, both crash outcomes, and
+    # the marker-plane classes under the snapshot supervisor (ISSUE 4)
     assert {"msg-faults", "crash-pause", "crash-lossy-recovered",
-            "crash-lossy-unrecovered"} <= set(names)
+            "crash-lossy-unrecovered", "marker-drop-retry",
+            "marker-dup-storm", "marker-drop-exhausted"} <= set(names)
     msg = next(r for r in verdict["scenarios"]
                if r["scenario"] == "msg-faults")
     for cls in ("drops", "dups", "jitters"):
@@ -36,3 +38,18 @@ def test_chaos_smoke_battery_green():
                  if r["scenario"] == "crash-lossy-unrecovered")
     assert unrec["errors_decoded"] == ["ERR_FAULT_UNRECOVERED"]
     assert unrec["quarantined_lanes"] > 0
+    # the drop storm stalled an attempt AND every snapshot completed via
+    # supervisor retry
+    retry = next(r for r in verdict["scenarios"]
+                 if r["scenario"] == "marker-drop-retry")
+    assert retry["fault_events"]["marker_drops"] > 0
+    assert retry["snapshot_lifecycle"]["retried"] > 0
+    assert (retry["snapshot_lifecycle"]["completed"]
+            == retry["snapshot_lifecycle"]["initiated"])
+    # total marker loss beyond the retry budget fails loudly, on the
+    # exhausted lanes only
+    exhaust = next(r for r in verdict["scenarios"]
+                   if r["scenario"] == "marker-drop-exhausted")
+    assert exhaust["errors_decoded"] == ["ERR_SNAPSHOT_TIMEOUT"]
+    assert exhaust["snapshot_lifecycle"]["failed"] > 0
+    assert exhaust["quarantined_lanes"] > 0

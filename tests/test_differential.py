@@ -46,7 +46,12 @@ def test_parity_vs_dense_random(case_seed):
         assert ps.messages == ds.messages  # exact order, not just per-dest
 
 
-@pytest.mark.parametrize("case_seed", range(4))
+@pytest.mark.parametrize("case_seed", [
+    0, 1,
+    # half the seed battery rides tier-1; the rest runs in full passes
+    # (tier-1 wall-clock budget — each seed is a ~8 s compile+run pair)
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow)])
 def test_cascade_vs_fold_exact_impls(case_seed):
     """The two formulations of the bit-exact tick — the reference-literal
     N-step source fold (ops/tick._tick) and the marker-cascade form

@@ -111,7 +111,11 @@ def test_load_stale_format_version_raises_checkpoint_error(
     monkeypatch.setattr(ckpt_mod, "_FORMAT_VERSION", 3)
     save_state(path, r.init_batch())
     monkeypatch.undo()
-    with pytest.raises(CheckpointError, match="format version"):
+    # the error names the offending version AND the supported range, so an
+    # operator holding a stale file knows both sides of the mismatch
+    with pytest.raises(CheckpointError,
+                       match=r"format version 3.*supported version range "
+                             r"v\d+\.\.v\d+"):
         load_state(path, r.init_batch())
 
 
@@ -126,6 +130,36 @@ def test_roundtrip_carries_fault_leaves(tmp_path):
     assert meta["note"] == "faulted"
     assert np.any(np.asarray(restored.fault_key))
     _assert_trees_equal(final, restored)
+
+
+def test_v5_roundtrip_carries_supervisor_leaves(tmp_path):
+    # format v5: the snapshot supervisor's books (epochs, deadlines,
+    # retries, initiators, completion ticks, stale tallies) survive the
+    # disk trip — a resumed run's timeout scan picks up EXACTLY where the
+    # killed one left off. The marker-drop adversary guarantees the saved
+    # state actually carries nonzero retry/epoch values.
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, snapshot_timeout=12, snapshot_retries=5)
+    faults = JaxFaults(3, marker_drop_rate=0.2)
+    r = BatchedRunner(SPEC, cfg, make_fast_delay("hash", 11), batch=2,
+                      scheduler="exact", faults=faults, quarantine=True)
+    final = r.run_storm(r.init_batch(), _prog(r.topo, phases=8))
+    host = np.asarray(jax.device_get(final.snap_retries))
+    assert host.sum() > 0, "fixture must exercise the retry path"
+    assert np.all(np.asarray(jax.device_get(final.snap_initiator))[
+        np.asarray(jax.device_get(final.started))] >= 0)
+    path = str(tmp_path / "supervised.npz")
+    save_state(path, final, meta={"note": "v5"})
+    restored, meta = load_state(path, r.init_batch())
+    assert meta["note"] == "v5"
+    _assert_trees_equal(final, restored)
+    for leaf in ("snap_epoch", "snap_deadline", "snap_retries",
+                 "snap_initiator", "snap_failed", "snap_done_time",
+                 "stale_markers"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(final, leaf))),
+            np.asarray(jax.device_get(getattr(restored, leaf))))
 
 
 # ---- kill-and-resume bit-exactness (python API) ------------------------
@@ -200,6 +234,42 @@ def test_cli_storm_kill_resume_bit_exact(tmp_path):
         for name in za.files:
             if name == "__header__":
                 continue                     # meta differs (next_phase etc.)
+            np.testing.assert_array_equal(za[name], zb[name])
+
+
+@pytest.mark.slow
+def test_cli_storm_kill_resume_bit_exact_under_marker_faults(tmp_path):
+    # ISSUE 4 acceptance: the v5 carry holds the supervisor's deadlines/
+    # epochs/retry budgets and the marker-fault stream key, so a kill
+    # right after a chunk checkpoint and a resume land bit-identically on
+    # the uninterrupted run — mid-retry, marker drops and all
+    base = ["storm", "--graph", "ring", "--nodes", "8", "--batch", "2",
+            "--phases", "9", "--snapshots", "1", "--seed", "3",
+            "--marker-fault-drop", "0.15", "--snapshot-timeout", "16",
+            "--snapshot-retries", "8"]
+    ref = str(tmp_path / "mref.npz")
+    code, out = _capture(base + ["--checkpoint", ref])
+    assert code == 0, out
+    ref_counters = json.loads(out.splitlines()[-1])
+
+    ck = str(tmp_path / "mmid.npz")
+    fin = str(tmp_path / "mresumed.npz")
+    code, out = _capture(base + ["--checkpoint", ck,
+                                 "--checkpoint-every", "3",
+                                 "--kill-after-chunk", "0"])
+    assert code == 17
+    code, out = _capture(base + ["--checkpoint", fin,
+                                 "--checkpoint-every", "3",
+                                 "--resume-from", ck])
+    assert code == 0, out
+    resumed_counters = json.loads(out.splitlines()[-1])
+    resumed_counters.pop("checkpoint"), ref_counters.pop("checkpoint")
+    assert resumed_counters == ref_counters
+    with np.load(ref) as za, np.load(fin) as zb:
+        assert set(za.files) == set(zb.files)
+        for name in za.files:
+            if name == "__header__":
+                continue
             np.testing.assert_array_equal(za[name], zb[name])
 
 

@@ -1,0 +1,274 @@
+"""The fault-tolerant snapshot plane: epoch-tagged markers, stale
+rejection, and the timeout/retry supervisor (ISSUE 4).
+
+Five claims:
+
+  1. ARMED-IDLE IS EXACT — with the supervisor armed but never firing
+     (huge timeout) all 7 reference goldens stay bit-identical to the
+     unsupervised kernels, and a storm's final state matches the
+     supervisor-off run on every leaf except the supervisor's own
+     bookkeeping (deadlines/initiators, which exist only when armed).
+  2. STALE EPOCHS ARE REJECTED — a ring marker from a superseded attempt
+     (the abort bumped ``snap_epoch``) is counted in ``stale_markers``
+     and handled by nobody: it cannot re-create local snapshots or close
+     the fresh attempt's recording windows.
+  3. TIMEOUT → RETRY → COMPLETE, DETERMINISTICALLY — under sustained
+     marker loss every initiated snapshot completes via supervisor retry,
+     the whole run replays bit-exactly from its seed (fresh traces
+     included), and exhausting the retry budget raises
+     ERR_SNAPSHOT_TIMEOUT on the exhausted lane only, surfaced through
+     ``decode_error_bits`` in the storm CLI's JSON.
+  4. THE DAEMON KEEPS THE RECOVERY LINE FRESH — ``snapshot_every``
+     initiates (and completes) snapshots with no scheduled initiations at
+     all, on the batched AND the graph-sharded runner, and the
+     recovery-line age metric reads from it.
+  5. CONSTRUCTION CONTRACTS — the reference-literal 'fold' refuses a
+     supervisor; bad marker rates are rejected at JaxFaults construction.
+
+The deepest differentials (golden parity x7, the sync-scheduler twin of
+the storm parity) carry the ``slow`` marker — tools/chaos_smoke.py keeps
+the tier-1 wall covered with the same claims.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.api import run_events_file
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import (
+    ERR_SNAPSHOT_TIMEOUT,
+    decode_error_bits,
+    init_state,
+)
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import (
+    ring_topology,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.compare import (
+    assert_snapshots_equal,
+    sort_snapshots,
+)
+from chandy_lamport_tpu.utils.fixtures import read_snapshot_file
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+
+SPEC = ring_topology(8, tokens=100)
+CFG = SimConfig.for_workload(snapshots=2, max_recorded=128)
+SUP = dataclasses.replace(CFG, snapshot_timeout=24, snapshot_retries=10)
+BATCH = 4
+
+
+def _storm(cfg, faults=None, scheduler="exact", phases=24, runner=None,
+           delay=None):
+    if runner is None:
+        runner = BatchedRunner(SPEC, cfg, delay or FixedJaxDelay(1),
+                               batch=BATCH, scheduler=scheduler,
+                               faults=faults,
+                               quarantine=faults is not None)
+    prog = storm_program(
+        runner.topo, phases=phases, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 1, 1, 2,
+                                            max_phases=phases))
+    return runner, jax.device_get(runner.run_storm(runner.init_batch(),
+                                                   prog))
+
+
+# ---- claim 1: armed-idle is exact --------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=[t[1].removesuffix(".events")
+                              for t in REFERENCE_TESTS])
+def test_armed_supervisor_keeps_goldens_bit_exact(top, events, snaps):
+    cfg = SimConfig(snapshot_timeout=50_000, snapshot_retries=3)
+    actual, _ = run_events_file(fixture_path(top), fixture_path(events),
+                                backend="jax", config=cfg)
+    expected = [read_snapshot_file(fixture_path(f)) for f in snaps]
+    assert len(actual) == len(expected)
+    for e, a in zip(sort_snapshots(expected), sort_snapshots(actual)):
+        assert_snapshots_equal(e, a)
+
+
+def _sans_sup_bookkeeping(state):
+    # deadlines and initiators are recorded only when the supervisor is
+    # armed — they ARE the supervisor's state, not the protocol's; every
+    # other leaf (epochs, retries, completion ticks, the whole cut) must
+    # match the unsupervised run bit for bit
+    return jax.tree_util.tree_leaves(state._replace(
+        snap_deadline=0, snap_initiator=0))
+
+
+@pytest.mark.parametrize("scheduler", [
+    "exact", pytest.param("sync", marks=pytest.mark.slow)])
+def test_armed_idle_storm_bit_identical_to_off(scheduler):
+    _, off = _storm(CFG, scheduler=scheduler)
+    big = dataclasses.replace(CFG, snapshot_timeout=50_000,
+                              snapshot_retries=3)
+    _, armed = _storm(big, scheduler=scheduler)
+    for a, b in zip(_sans_sup_bookkeeping(off), _sans_sup_bookkeeping(armed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---- claim 2: stale-epoch rejection ------------------------------------
+
+
+def test_stale_epoch_markers_rejected():
+    # hand-build the post-abort race: initiate a snapshot (epoch-0 markers
+    # land in the rings), then apply exactly what the supervisor's abort
+    # does — bump the epoch, clear the cut — and let the stragglers drain.
+    # They must die counted, not handled.
+    from chandy_lamport_tpu.core.dense import DenseSim
+
+    sim = DenseSim(SPEC, FixedJaxDelay(1), config=SUP)
+    k = sim.kernel
+    s = k.inject_snapshot(sim.state, np.int32(0))
+    s = jax.device_get(s)
+    assert int(np.asarray(s.q_len).sum()) == 1      # ring-8: one marker out
+    patched = s._replace(
+        snap_epoch=np.asarray(s.snap_epoch).copy() * 0 + np.int32(
+            np.arange(len(s.snap_epoch)) == 0),     # epoch[0] = 1
+        has_local=np.zeros_like(np.asarray(s.has_local)),
+        recording=np.zeros_like(np.asarray(s.recording)),
+        rem=np.zeros_like(np.asarray(s.rem)),
+        frozen=np.zeros_like(np.asarray(s.frozen)),
+    )
+    out = jax.device_get(k.run_ticks(jax.device_put(patched), np.int32(20)))
+    assert int(out.stale_markers) == 1
+    # the stale marker created nothing and closed nothing
+    assert not np.any(np.asarray(out.has_local))
+    assert not np.any(np.asarray(out.recording))
+    assert int(np.asarray(out.q_len).sum()) == 0    # drained, not wedged
+
+
+# ---- claim 3: timeout -> retry -> complete, deterministically ----------
+
+
+@pytest.mark.slow
+def test_marker_loss_recovers_via_retry_and_replays_bit_exactly():
+    # tier-1 carries the retry->complete claim via tools/chaos_smoke.py's
+    # marker-drop-retry scenario; the three-storm replay differential
+    # (same trace, then fresh traces) runs in full passes
+    adversary = JaxFaults(3, marker_drop_rate=0.1)
+    runner, a = _storm(SUP, adversary)
+    lc = BatchedRunner.summarize(a)["snapshot_lifecycle"]
+    assert lc["retried"] > 0, lc                    # the storm actually bit
+    assert lc["completed"] == lc["initiated"], lc   # and retry recovered it
+    assert not np.any(np.asarray(a.error))
+    # same trace, same keys -> bit-identical replay
+    _, b = _storm(SUP, adversary, runner=runner)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # fresh runner (fresh XLA traces — nothing survives but the seed):
+    # still bit-identical, the replay-from-seed property
+    _, c = _storm(SUP, JaxFaults(3, marker_drop_rate=0.1))
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(c)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+def test_exhausted_retries_raise_snapshot_timeout_only():
+    # tier-1 carries exhaustion through the CLI test below and the chaos
+    # battery's marker-drop-exhausted scenario
+    cfg = dataclasses.replace(CFG, snapshot_timeout=10, snapshot_retries=2)
+    _, final = _storm(cfg, JaxFaults(3, marker_drop_rate=1.0), phases=16)
+    errs = np.asarray(final.error)
+    assert np.all(errs & ERR_SNAPSHOT_TIMEOUT)
+    assert decode_error_bits(int(errs[0])) == ["ERR_SNAPSHOT_TIMEOUT"]
+    lc = BatchedRunner.summarize(final)["snapshot_lifecycle"]
+    assert lc["failed"] > 0 and lc["completed"] == 0
+    # quarantined: the lanes froze instead of grinding to ERR_TICK_LIMIT
+    assert np.all(np.asarray(final.time) < CFG.max_ticks)
+
+
+def test_cli_storm_surfaces_snapshot_timeout(capsys):
+    import json
+
+    from chandy_lamport_tpu.cli import main
+
+    rc = main(["storm", "--graph", "ring", "--nodes", "8", "--batch", "2",
+               "--phases", "8", "--snapshots", "1", "--seed", "3",
+               "--marker-fault-drop", "1.0", "--snapshot-timeout", "8",
+               "--snapshot-retries", "1"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    counters = json.loads(out)
+    # the injured lanes are quarantined with the decoded bit on the row —
+    # an armed adversary expects casualties, so the run itself succeeds
+    assert rc == 0
+    assert "ERR_SNAPSHOT_TIMEOUT" in counters["errors_decoded"]
+    assert counters["snapshot_lifecycle"]["failed"] > 0
+    assert counters["quarantined_lanes"] > 0
+    assert any("ERR_SNAPSHOT_TIMEOUT" in v
+               for v in counters["lane_errors"].values())
+
+
+# ---- claim 4: the snapshot_every daemon --------------------------------
+
+
+def test_daemon_initiates_and_completes_without_schedule():
+    cfg = dataclasses.replace(CFG, snapshot_every=6, snapshot_timeout=64,
+                              snapshot_retries=2)
+    runner = BatchedRunner(SPEC, cfg, FixedJaxDelay(1), batch=2,
+                           scheduler="sync")
+    prog = storm_program(runner.topo, phases=20, amount=1,
+                         snapshot_phases={})
+    final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+    lc = BatchedRunner.summarize(final)["snapshot_lifecycle"]
+    assert lc["initiated"] > 0
+    assert lc["completed"] == lc["initiated"], lc
+    assert lc["recovery_line_age_max"] >= 0        # a recovery line exists
+    assert not np.any(np.asarray(final.error))
+
+
+def test_graphshard_daemon_and_supervisor():
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    from chandy_lamport_tpu.utils.metrics import snapshot_lifecycle
+
+    cfg = dataclasses.replace(
+        SimConfig.for_workload(snapshots=4, max_recorded=128),
+        snapshot_every=6, snapshot_timeout=64, snapshot_retries=2)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("graph",))
+    runner = GraphShardedRunner(SPEC, cfg, mesh, seed=7, fixed_delay=1)
+    prog = storm_program(runner.topo, phases=20, amount=1,
+                         snapshot_phases={})
+    final = jax.device_get(runner.run_storm(
+        runner.init_state(), np.asarray(prog.amounts),
+        np.asarray(prog.snap)))
+    lc = {k: int(v) for k, v in snapshot_lifecycle(final,
+                                                   runner.topo.n).items()}
+    assert lc["initiated"] > 0
+    assert lc["completed"] == lc["initiated"], lc
+    assert int(np.asarray(final.error)) == 0
+
+
+# ---- claim 5: construction contracts -----------------------------------
+
+
+def test_fold_refuses_supervisor():
+    with pytest.raises(ValueError, match="fold"):
+        BatchedRunner(SPEC, SUP, make_fast_delay("hash", 11), batch=2,
+                      scheduler="exact", exact_impl="fold")
+
+
+@pytest.mark.parametrize("kw", [
+    {"marker_drop_rate": -0.1}, {"marker_dup_rate": 1.5},
+    {"marker_jitter_rate": 2.0},
+])
+def test_adversary_rejects_bad_marker_programs(kw):
+    with pytest.raises(ValueError):
+        JaxFaults(7, **kw)
+
+
+def test_describe_carries_marker_rates():
+    d = JaxFaults(7, marker_drop_rate=0.25, marker_dup_rate=0.5).describe()
+    assert d["marker_drop"] == 0.25 and d["marker_dup"] == 0.5

@@ -62,7 +62,13 @@ def _assert_states_identical(a, b):
     assert dense_state_mismatches(a, b) == []
 
 
-@pytest.mark.parametrize("case_seed", range(4))
+@pytest.mark.parametrize("case_seed", [
+    0, 1,
+    # half the seed battery rides tier-1; the rest runs in full passes
+    # (the PR-3 re-tiering mechanism — tier-1 lives under a hard
+    # wall-clock budget and each seed costs a ~11 s compile+storm)
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(3, marks=pytest.mark.slow)])
 def test_wave_vs_cascade_random_storms(case_seed):
     """Randomized graph families under the hash sampler (per-lane
     position-addressable streams — the production exact-bench sampler)."""

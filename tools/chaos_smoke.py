@@ -59,6 +59,7 @@ def main() -> int:
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.core.state import (
         ERR_FAULT_UNRECOVERED,
+        ERR_SNAPSHOT_TIMEOUT,
         decode_error_bits,
     )
     from chandy_lamport_tpu.models.faults import JaxFaults
@@ -74,9 +75,18 @@ def main() -> int:
 
     import numpy as np
 
+    import dataclasses
+
     sf = scale_free(16, 2, seed=5, tokens=100)
     ring = ring_topology(8, tokens=100)
     cfg = SimConfig.for_workload(snapshots=2, max_recorded=128)
+    # marker-plane scenarios run under the snapshot supervisor (ISSUE 4):
+    # a generous retry budget for the recover-via-retry classes, a tight
+    # one for the deliberate exhaustion
+    sup_cfg = dataclasses.replace(cfg, snapshot_timeout=24,
+                                  snapshot_retries=10)
+    exhaust_cfg = dataclasses.replace(cfg, snapshot_timeout=10,
+                                      snapshot_retries=2)
     s = args.seed
 
     # scenario := (name, topology, delay, phases, snapshot start phase,
@@ -90,27 +100,44 @@ def main() -> int:
     # classes ride one combined scenario — per-class firing is still
     # asserted individually off fault_counts — and each crash outcome gets
     # its own scheduled program
+    # the marker-plane rows (ISSUE 4): a drop storm against an ACTIVE
+    # snapshot (initiated phase 1, drops all run) that must recover via
+    # supervisor timeout+retry; a dup storm that must complete without the
+    # duplicates corrupting the cut; and a total-loss run whose retry
+    # budget is deliberately too small — the supervisor must fail LOUDLY
+    # (ERR_SNAPSHOT_TIMEOUT, quarantined) rather than stall forever
     scenarios = [
         ("msg-faults", sf, make_fast_delay("hash", 11), args.phases, 1,
          JaxFaults(s, drop_rate=0.05, dup_rate=0.05, jitter_rate=0.05),
-         ("drops", "dups", "jitters"), 0),
+         ("drops", "dups", "jitters"), 0, cfg, None),
         ("crash-pause", sf, make_fast_delay("hash", 11), args.phases, 1,
          JaxFaults(s, crash_rate=0.5, crash_mode="pause",
-                   crash_period=8, crash_len=2), ("crashes",), 0),
+                   crash_period=8, crash_len=2), ("crashes",), 0, cfg,
+         None),
         ("crash-lossy-recovered", ring, FixedJaxDelay(1), 48, 1,
          JaxFaults(s, crash_rate=1.0, crash_mode="lossy",
-                   crash_start=30, crash_len=2), ("crashes",), 0),
+                   crash_start=30, crash_len=2), ("crashes",), 0, cfg,
+         None),
         ("crash-lossy-unrecovered", ring, FixedJaxDelay(1), 24, 1,
          JaxFaults(s, crash_rate=1.0, crash_mode="lossy",
                    crash_start=5, crash_len=2), ("crashes",),
-         ERR_FAULT_UNRECOVERED),
+         ERR_FAULT_UNRECOVERED, cfg, None),
+        ("marker-drop-retry", ring, FixedJaxDelay(1), 24, 1,
+         JaxFaults(s, marker_drop_rate=0.1), ("marker_drops",), 0,
+         sup_cfg, "retry"),
+        ("marker-dup-storm", ring, FixedJaxDelay(1), 24, 1,
+         JaxFaults(s, marker_dup_rate=0.4), ("marker_dups",), 0,
+         sup_cfg, "complete"),
+        ("marker-drop-exhausted", ring, FixedJaxDelay(1), 16, 1,
+         JaxFaults(s, marker_drop_rate=1.0), ("marker_drops",),
+         ERR_SNAPSHOT_TIMEOUT, exhaust_cfg, "exhaust"),
     ]
 
     t0 = time.time()
     rows, ok = [], True
     for (name, spec, delay, phases, snap0, adversary, fired_classes,
-         want_bits) in scenarios:
-        runner = BatchedRunner(spec, cfg, delay, batch=args.batch,
+         want_bits, scfg, sup_check) in scenarios:
+        runner = BatchedRunner(spec, scfg, delay, batch=args.batch,
                                scheduler="exact", faults=adversary,
                                quarantine=True)
         prog = storm_program(
@@ -119,8 +146,9 @@ def main() -> int:
                                                 max_phases=phases))
         final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
         summary = BatchedRunner.summarize(final)
+        lc = summary["snapshot_lifecycle"]
         expected = int(runner.topo.tokens0.sum()) * args.batch
-        delta = int(conservation_delta(final, cfg, expected))
+        delta = int(conservation_delta(final, scfg, expected))
         errs = np.asarray(final.error)
 
         checks = {
@@ -135,16 +163,29 @@ def main() -> int:
                 True if not want_bits else
                 bool(np.all(errs & want_bits)
                      and np.all(np.asarray(final.time)[errs != 0]
-                                < int(cfg.max_ticks)))),
+                                < int(scfg.max_ticks)))),
         }
         if want_bits == 0:
             checks["recovered_clean"] = summary["error_lanes"] == 0
+        if sup_check == "retry":
+            # the drop storm stalled at least one attempt (timeout fired)
+            # and every initiated snapshot still completed via retry
+            checks["supervisor_retried"] = lc["retried"] > 0
+            checks["all_completed"] = lc["completed"] == lc["initiated"]
+        elif sup_check == "complete":
+            checks["all_completed"] = lc["completed"] == lc["initiated"]
+        elif sup_check == "exhaust":
+            # total marker loss: every attempt burned its budget and
+            # failed loudly — nothing completed, nothing wedged
+            checks["supervisor_failed_loudly"] = (
+                lc["failed"] > 0 and lc["completed"] == 0)
         row = {
             "scenario": name,
             "fault_events": summary["fault_events"],
             "fault_skew": summary["fault_skew"],
             "conservation_delta": delta,
             "errors_decoded": summary["errors_decoded"],
+            "snapshot_lifecycle": lc,
             "quarantined_lanes": int((errs != 0).sum()),
             "checks": checks,
             "ok": all(checks.values()),

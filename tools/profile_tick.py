@@ -278,6 +278,53 @@ def main() -> None:
             print(f"  {fname:<10} {t * 1e3:9.3f} ms/tick "
                   f"({(t / base - 1) * 100:+6.2f}% vs off)", file=sys.stderr)
 
+    # ---- supervisor overhead: the epoch-check + timeout-scan tax, -------
+    # measured (the PR-3 faults section's pattern). Three kernels at the
+    # same shape: supervisor off (zero supervisor ops in the trace),
+    # armed-idle (timeout huge — the pure scan/clear/epoch-decode cost with
+    # nothing ever firing), and active (tight timeout + the snapshot_every
+    # daemon, so aborts/retries/initiations actually run).
+    if args.scheduler == "exact" and args.exact_impl == "fold":
+        print("supervisor: skipped (exact_impl='fold' is the reference-"
+              "literal specification form and carries no supervisor)",
+              file=sys.stderr)
+    else:
+        import dataclasses
+
+        svariants = [
+            ("off", {}),
+            ("armed-idle", {"snapshot_timeout": 1 << 20,
+                            "snapshot_retries": 3}),
+            ("active", {"snapshot_timeout": 8, "snapshot_retries": 3,
+                        "snapshot_every": 16}),
+        ]
+        stimings = {}
+        for sname, patch in svariants:
+            sr = (runner if not patch else
+                  BatchedRunner(spec, dataclasses.replace(cfg, **patch),
+                                make_fast_delay(args.delay, 17),
+                                batch=args.batch, scheduler=args.scheduler,
+                                exact_impl=args.exact_impl,
+                                megatick=args.megatick,
+                                queue_engine=args.queue_engine))
+            stick = jax.jit(jax.vmap(sr._tick_fn), donate_argnums=0)
+            st = sr.init_batch_device()
+            st = stick(st)                        # compile + warm
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            for _ in range(args.ticks):
+                st = stick(st)
+            jax.block_until_ready(st)
+            stimings[sname] = (time.perf_counter() - t0) / args.ticks
+        sbase = stimings["off"]
+        print("supervisor (timeout-scan + epoch-check overhead):",
+              file=sys.stderr)
+        for sname, _ in svariants:
+            t = stimings[sname]
+            print(f"  {sname:<10} {t * 1e3:9.3f} ms/tick "
+                  f"({(t / sbase - 1) * 100:+6.2f}% vs off)",
+                  file=sys.stderr)
+
     if args.scheduler == "exact":
         # per-stage wall-clock of the fused exact path: how much of a
         # dispatch is tick-start delivery selection (_select_and_pop, the
