@@ -10,6 +10,9 @@ this covers the same ground and the scale workflows the reference lacks:
          the CLI twin of the pytest suite
   storm  batched scale run (instances x storm program) with aggregate
          metrics, optional checkpointing
+  stream continuous lane scheduling: drive a queue of J heterogeneous jobs
+         through B lane slots, refilling each slot the moment its job
+         retires (parallel/batch.run_stream); prints jobs/s + occupancy
   bench  the node-ticks/sec benchmark (same engine as /bench.py)
 
 Usage: python -m chandy_lamport_tpu <command> [args]
@@ -250,6 +253,93 @@ def _cmd_storm(args) -> int:
     return 0 if (faults is not None and quarantine) else 1
 
 
+def _cmd_stream(args) -> int:
+    import time
+
+    import jax
+
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
+        scale_free,
+        stream_jobs,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.checkpoint import load_state
+
+    if args.checkpoint_every and not args.checkpoint:
+        print("--checkpoint-every needs --checkpoint PATH (the file the "
+              "periodic (state, stream) snapshots land in)", file=sys.stderr)
+        return 2
+    tokens = args.max_phases + 10
+    gen = {"ring": lambda: ring_topology(args.nodes, tokens=tokens),
+           "er": lambda: erdos_renyi(args.nodes, 3.0, args.seed,
+                                     tokens=tokens),
+           "sf": lambda: scale_free(args.nodes, 2, args.seed,
+                                    tokens=tokens)}[args.graph]
+    spec = gen()
+    cfg = SimConfig.for_workload(snapshots=args.snapshots,
+                                 split_markers=args.scheduler == "sync")
+    faults = None
+    if any((args.fault_drop, args.fault_dup, args.fault_jitter)):
+        from chandy_lamport_tpu.models.faults import JaxFaults
+
+        faults = JaxFaults(
+            args.fault_seed if args.fault_seed is not None else args.seed,
+            drop_rate=args.fault_drop, dup_rate=args.fault_dup,
+            jitter_rate=args.fault_jitter)
+    runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
+                           batch=args.batch, scheduler=args.scheduler,
+                           faults=faults, quarantine=faults is not None)
+    jcount = args.jobs or 3 * args.batch
+    jobs = stream_jobs(spec, jcount, seed=args.seed,
+                       base_phases=args.base_phases,
+                       tail_alpha=args.tail_alpha,
+                       max_phases=args.max_phases)
+    pool = runner.pack_jobs(jobs)
+    state = stream = None
+    if args.resume_from:
+        # same-flags `like` template: shape/treedef validation rejects a
+        # checkpoint from a different queue or batch shape
+        like = (runner.init_batch(), runner.init_stream(pool))
+        (state, stream), meta = load_state(args.resume_from, like)
+        print(f"resumed from {args.resume_from} at {meta}", file=sys.stderr)
+    t0 = time.perf_counter()
+    state, stream = runner.run_stream(
+        pool, stretch=args.stretch, drain_chunk=args.drain_chunk,
+        admission=args.admission, state=state, stream=stream,
+        checkpoint=args.checkpoint, checkpoint_every=args.checkpoint_every,
+        kill_after_saves=args.kill_after_saves)
+    jax.block_until_ready(state.time)
+    wall = time.perf_counter() - t0
+    done = int(stream.jobs_done)
+    if args.kill_after_saves is not None and done < jcount:
+        # deterministic mid-queue "preemption" for the resume tests: die
+        # right after that many checkpoints landed
+        print(json.dumps({"killed_after_steps": int(stream.steps),
+                          "jobs_done": done,
+                          "checkpoint": args.checkpoint}))
+        return 17
+    row = runner.summarize_stream(stream)
+    row.update({"graph": args.graph, "nodes": runner.topo.n,
+                "batch": args.batch, "jobs": jcount,
+                "admission": args.admission, "scheduler": args.scheduler,
+                "wall_seconds": round(wall, 3),
+                "jobs_per_sec": round(done / wall, 2) if wall > 0 else 0.0})
+    errored = [r for r in runner.stream_results(stream) if r["error"]]
+    row["jobs_errored"] = len(errored)
+    if errored:
+        # per-job decode for the injured jobs (first 16) — readable
+        # straight off the JSON row, like storm's lane_errors
+        row["job_errors"] = {r["job"]: r["errors_decoded"]
+                             for r in errored[:16]}
+    print(json.dumps(row))
+    # an armed adversary EXPECTS casualties (quarantined + harvested with
+    # their error bits); without one any errored job is a failure
+    return 0 if (faults is not None or not errored) else 1
+
+
 def _cmd_bench(args) -> int:
     from chandy_lamport_tpu.bench import main as bench_main
 
@@ -401,6 +491,56 @@ def main(argv=None) -> int:
     #                                          right after that chunk's
     #                                          checkpoint lands
     ps.set_defaults(fn=_cmd_storm)
+
+    pq = sub.add_parser("stream", help="continuous-lane streaming run "
+                                       "(job queue over B slots)")
+    pq.add_argument("--graph", choices=["ring", "er", "sf"], default="sf")
+    pq.add_argument("--nodes", type=int, default=256)
+    pq.add_argument("--batch", type=int, default=64,
+                    help="lane slots B (device batch width)")
+    pq.add_argument("--jobs", type=int, default=0,
+                    help="queued jobs J (0 = 3x batch)")
+    pq.add_argument("--base-phases", type=int, default=4,
+                    help="heavy-tailed job lengths: Pareto(base, alpha) "
+                         "phases per job (models/workloads.stream_jobs)")
+    pq.add_argument("--tail-alpha", type=float, default=1.1)
+    pq.add_argument("--max-phases", type=int, default=32)
+    pq.add_argument("--snapshots", type=int, default=8)
+    pq.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    pq.add_argument("--seed", type=int, default=0)
+    pq.add_argument("--delay", choices=["uniform", "hash"], default="hash")
+    pq.add_argument("--admission", choices=["stream", "gang"],
+                    default="stream",
+                    help="'stream' refills a slot the moment its job "
+                         "retires; 'gang' waits for every slot to idle — "
+                         "the static-batching baseline on the same "
+                         "executable")
+    pq.add_argument("--stretch", type=int, default=4,
+                    help="script phases advanced per jitted stream step")
+    pq.add_argument("--drain-chunk", type=int, default=32,
+                    help="drain ticks per stream step for quiescing lanes")
+    pq.add_argument("--fault-drop", type=float, default=0.0, metavar="R",
+                    help="fault adversary: per-(edge, tick) token-drop "
+                         "probability, armed per JOB (each job replays its "
+                         "own stream wherever it lands)")
+    pq.add_argument("--fault-dup", type=float, default=0.0, metavar="R")
+    pq.add_argument("--fault-jitter", type=float, default=0.0, metavar="R")
+    pq.add_argument("--fault-seed", type=int, default=None,
+                    help="adversary stream seed (default: --seed)")
+    pq.add_argument("--checkpoint", help="save the combined (state, stream) "
+                                         "carry to this .npz")
+    pq.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                    help="checkpoint to --checkpoint every K stream steps; "
+                         "a killed run resumes via --resume-from to a "
+                         "bit-identical finish (admission order and per-job "
+                         "streams live in the saved carry)")
+    pq.add_argument("--resume-from", metavar="PATH",
+                    help="resume a streaming run from a checkpoint written "
+                         "by --checkpoint-every (pass the SAME flags)")
+    pq.add_argument("--kill-after-saves", type=int, default=None,
+                    help=argparse.SUPPRESS)  # resume-test hook: exit 17
+    #                                          after that many checkpoints
+    pq.set_defaults(fn=_cmd_stream)
 
     pb = sub.add_parser("bench", help="node-ticks/sec benchmark")
     pb.add_argument("bench_args", nargs=argparse.REMAINDER)

@@ -332,6 +332,22 @@ class DenseState(NamedTuple):
     snap_done_time: Any  # i32 [S] tick the snapshot completed on all nodes
     #                     (-1 until then; recovery-line age metric)
     stale_markers: Any  # i32 [] superseded-epoch marker arrivals rejected
+    # streaming-engine state (parallel/batch.run_stream; checkpoint format
+    # v6 leaves). A batched run's lanes stop being one-shot: the streaming
+    # driver retires a lane the moment its job is quiescent-and-complete
+    # (or quarantined), harvests its summary into the results ring, and
+    # scatters a FRESH job into the slot — so these three per-lane words
+    # are the whole identity of "which job is this lane running, and how
+    # far along is it". They ride the carry (not host bookkeeping) so a
+    # checkpoint taken mid-queue resumes the admission state bit-exactly.
+    job_id: Any        # i32 [] pool index of the job this lane is running
+    #                    (-1 = idle slot: never admitted, or harvested and
+    #                    the queue was empty). Non-streaming runs leave -1.
+    prog_cursor: Any   # i32 [] next phase row in the pooled ScriptOps
+    #                    table; past the job's end it encodes the retire
+    #                    stages (end=drain, end+1=flush, end+2=done)
+    admit_tick: Any    # i32 [] stream step at which the job was admitted
+    #                    (occupancy/latency accounting; 0 for lane 0 jobs)
     error: Any         # i32 [] sticky bitmask
 
 
@@ -379,6 +395,9 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any,
         snap_failed=np.zeros(s, b),
         snap_done_time=np.full(s, -1, i32),
         stale_markers=np.int32(0),
+        job_id=np.int32(-1),
+        prog_cursor=np.int32(0),
+        admit_tick=np.int32(0),
         error=np.int32(0),
     )
 

@@ -20,6 +20,12 @@ from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from chandy_lamport_tpu.core.spec import (
+    Event,
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
 from chandy_lamport_tpu.core.state import DenseTopology
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 
@@ -75,6 +81,48 @@ def scale_free(n: int, attach: int, seed: int,
                 degree[j] += 1
                 targets.append(j)
     return TopologySpec(nodes, sorted(links))
+
+
+def stream_jobs(spec: TopologySpec, count: int, seed: int,
+                base_phases: int = 4, tail_alpha: float = 1.1,
+                max_phases: int = 64, amount: int = 1,
+                snapshots_per_job: int = 1) -> List[List[Event]]:
+    """A heavy-tailed job mix for the streaming engine
+    (parallel/batch.run_stream): ``count`` event-list jobs whose phase
+    counts follow a Pareto(``tail_alpha``) tail over ``base_phases``
+    (clamped to ``max_phases``), so a few jobs run an order of magnitude
+    longer than the median — the distribution where static batching pays
+    the whole batch's wall clock for its slowest member. Each phase sends
+    ``amount`` tokens over one link (rotating through the link list with a
+    per-job offset, so traffic stays shallow per node and no balance ever
+    underflows for any sane phase cap); each job initiates
+    ``snapshots_per_job`` snapshots, the first early (phase 1) and the
+    rest spread, from a per-job rotating initiator. Deterministic in
+    ``seed``."""
+    if count < 1:
+        raise ValueError("count must be >= 1")
+    rng = random.Random(seed)
+    links = list(spec.links)
+    node_ids = [nid for nid, _ in spec.nodes]
+    jobs: List[List[Event]] = []
+    for j in range(count):
+        phases = min(max_phases,
+                     max(1, int(base_phases * rng.paretovariate(tail_alpha))))
+        snap_at = {min(1, phases - 1)}
+        for k in range(1, snapshots_per_job):
+            snap_at.add((k * phases) // snapshots_per_job)
+        ev: List[Event] = []
+        snaps_fired = 0
+        for p in range(phases):
+            src, dest = links[(j * 7 + p) % len(links)]
+            ev.append(PassTokenEvent(src=src, dest=dest, tokens=amount))
+            if p in snap_at:
+                ev.append(SnapshotEvent(
+                    node_id=node_ids[(j + snaps_fired) % len(node_ids)]))
+                snaps_fired += 1
+            ev.append(TickEvent(1))
+        jobs.append(ev)
+    return jobs
 
 
 class StormProgram(NamedTuple):

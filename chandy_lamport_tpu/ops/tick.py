@@ -1919,3 +1919,64 @@ class TickKernel:
 
     def _sync_drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._sync_tick)
+
+
+# ---- streaming-engine primitives (parallel/batch.run_stream) ------------
+#
+# The streaming driver retires finished lanes and admits queued jobs into
+# their slots in place, inside the jitted step. These two primitives are its
+# state surgery: ``harvest_lane_summaries`` reads every per-job result field
+# out of a batched state as [B] reductions (scattered into the results ring
+# by the caller BEFORE the slot is recycled), and ``reset_lanes`` scatters a
+# fresh ``init_state`` into the masked lanes of the donated batch leaves —
+# jnp.where per leaf against the unbatched template, so an admitted job
+# starts from EXACTLY the state a static run's init_batch would give it
+# (the stream-vs-static bit-exactness oracle rests on this).
+
+
+def harvest_lane_summaries(state: DenseState, num_nodes: int) -> dict:
+    """Per-lane job summary fields of a lead-batched state, each [B]:
+    the final token balances plus every counter the per-job results ring
+    (parallel/batch.StreamState) carries. Read BEFORE reset_lanes wipes
+    the slot; decoding error bits to names stays a host-side concern
+    (state.decode_error_bits on the harvested ints)."""
+    complete = state.started & (state.completed >= num_nodes)
+    return {
+        "tokens": state.tokens,                                   # [B, N]
+        "time": state.time,                                       # [B]
+        "error": state.error,                                     # [B]
+        "snap_started": jnp.sum(state.started, axis=-1,
+                                dtype=_i32),                      # [B]
+        "snap_completed": jnp.sum(complete, axis=-1, dtype=_i32),  # [B]
+        "snap_failed": jnp.sum(state.snap_failed, axis=-1,
+                               dtype=_i32),                       # [B]
+        "fault_skew": state.fault_skew,                           # [B]
+        "fault_events": jnp.sum(state.fault_counts, axis=-1,
+                                dtype=_i32),                      # [B]
+    }
+
+
+def reset_lanes(state: DenseState, mask, topo: DenseTopology,
+                cfg: SimConfig) -> DenseState:
+    """Scatter a fresh ``init_state`` into every lane where ``mask`` [B] is
+    True: each simulation leaf becomes ``where(mask, fresh, old)`` against
+    the unbatched template, so a recycled slot is bit-identical to a lane
+    of a fresh init_batch. The per-job stream identities — ``delay_state``,
+    ``fault_key`` and the job_id/prog_cursor/admit_tick leaves — are left
+    UNTOUCHED (the admission step overwrites them from the job pool; a
+    bare reset would wrongly replay lane-indexed streams)."""
+    from chandy_lamport_tpu.core.state import init_state
+
+    fresh = init_state(topo, cfg, None)._replace(delay_state=())
+    keep = {"delay_state": state.delay_state, "fault_key": state.fault_key,
+            "job_id": state.job_id, "prog_cursor": state.prog_cursor,
+            "admit_tick": state.admit_tick}
+    flat = state._replace(delay_state=())
+
+    def mix(old, tpl):
+        old = jnp.asarray(old)
+        m = jnp.reshape(mask, mask.shape + (1,) * (old.ndim - mask.ndim))
+        return jnp.where(m, jnp.asarray(tpl)[None], old)
+
+    out = jax.tree_util.tree_map(mix, flat, fresh)
+    return out._replace(**keep)

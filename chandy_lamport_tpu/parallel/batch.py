@@ -37,10 +37,15 @@ from chandy_lamport_tpu.core.state import (
     DenseState,
     DenseTopology,
     ERR_CONSERVATION,
+    ERR_TICK_LIMIT,
     init_state,
 )
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
-from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.ops.tick import (
+    TickKernel,
+    harvest_lane_summaries,
+    reset_lanes,
+)
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 from chandy_lamport_tpu.utils.layouts import (
     HAVE_LAYOUTS,
@@ -132,6 +137,63 @@ def compile_events(topo: DenseTopology, events: List[Event]) -> ScriptOps:
         for j, (op, a0, a1) in enumerate(ops):
             kind[i, j], arg0[i, j], arg1[i, j] = op, a0, a1
     return ScriptOps(kind, arg0, arg1, do_tick)
+
+
+class JobPool(NamedTuple):
+    """J compiled jobs packed into one pooled phase table, indexed by each
+    lane's ``prog_cursor`` (core/state.py streaming leaves). Rows
+    ``job_start[j]..job_end[j]`` are job j's phases (shorter phases padded
+    to the pool-wide K with OP_NOP — semantically free, a nop draws no
+    PRNG); ``job_limit[j]`` is the drain tick budget measured from tick 0
+    (= job j's total scripted ticks + SimConfig.max_ticks — scripted phases
+    advance time by exactly their tick counts, so this equals the static
+    drain's entry-time-relative ``time + max_ticks`` limit bit-exactly).
+    ``fault_key[j]``/``delay_state[j]`` are the PER-JOB stream identities
+    (models/faults + ops/delay_jax ``init_batch_state(J)``): admission
+    copies job j's row into the lane, so job j replays the same fault and
+    delay streams whichever lane runs it, whenever it was admitted — the
+    stream-vs-static parity oracle."""
+
+    kind: Any        # i32 [P, K]  pooled phase ops (batch.compile_events)
+    arg0: Any        # i32 [P, K]
+    arg1: Any        # i32 [P, K]
+    do_tick: Any     # i32 [P]     tick count closing each phase
+    job_start: Any   # i32 [J]     first pooled row of job j
+    job_end: Any     # i32 [J]     one past job j's last row
+    job_limit: Any   # i32 [J]     drain budget: total script ticks + max_ticks
+    fault_key: Any   # u32 [J]     per-job adversary key (0 = disarmed)
+    delay_state: Any  # pytree, leaves [J, ...]: per-job delay stream rows
+
+    @property
+    def num_jobs(self) -> int:
+        return int(np.shape(self.job_start)[0])
+
+
+class StreamState(NamedTuple):
+    """The streaming driver's carry beside the lane batch (run_stream):
+    admission bookkeeping + occupancy accounting + the device-side per-job
+    results ring the harvest step scatters retired lanes into. Saved
+    TOGETHER with the lane state by streaming checkpoints (the combined
+    ``(state, stream)`` pytree through utils/checkpoint.save_state), so a
+    resumed run continues mid-queue bit-exactly."""
+
+    next_job: Any          # i32 []  jobs admitted so far (= next pool index)
+    jobs_done: Any         # i32 []  jobs harvested into the ring
+    steps: Any             # i32 []  stream steps executed
+    refills: Any           # i32 []  admissions into a RECYCLED slot
+    lane_steps_live: Any   # i32 []  lane-substeps that advanced a live job
+    lane_steps_total: Any  # i32 []  lane-substeps charged (occupancy denom)
+    res_count: Any         # i32 []  results written (ring wraps past R)
+    res_job: Any            # i32 [R]    job id (-1 = empty slot)
+    res_time: Any           # i32 [R]    final lane clock
+    res_error: Any          # i32 [R]    sticky error bits at harvest
+    res_snap_started: Any   # i32 [R]    snapshots initiated
+    res_snap_completed: Any  # i32 [R]   snapshots completed on all nodes
+    res_snap_failed: Any    # i32 [R]    supervisor-failed attempts
+    res_fault_skew: Any     # i32 [R]    adversary token delta
+    res_fault_events: Any   # i32 [R]    adversary events, all classes
+    res_admit_step: Any     # i32 [R]    stream step the job was admitted at
+    res_tokens: Any         # i32 [R, N] final node balances
 
 
 class BatchedRunner:
@@ -370,12 +432,14 @@ class BatchedRunner:
                     tokens=jnp.broadcast_to(
                         tokens0, (self.batch,) + tokens0.shape),
                     # the non-zero inits beside tokens (state.init_state):
-                    # "no protected window yet" = int32 max, and the
-                    # supervisor's "unset" initiator/completion-tick = -1
+                    # "no protected window yet" = int32 max, the
+                    # supervisor's "unset" initiator/completion-tick = -1,
+                    # and the streaming engine's "idle slot" job id = -1
                     min_prot=jnp.full_like(st.min_prot,
                                            jnp.iinfo(jnp.int32).max),
                     snap_initiator=jnp.full_like(st.snap_initiator, -1),
-                    snap_done_time=jnp.full_like(st.snap_done_time, -1))
+                    snap_done_time=jnp.full_like(st.snap_done_time, -1),
+                    job_id=jnp.full_like(st.job_id, -1))
                 if self.faults is not None:
                     st = st._replace(
                         fault_key=self.faults.init_batch_state(self.batch))
@@ -631,6 +695,388 @@ class BatchedRunner:
             self._storm_state_formats = input_formats(comp)[0][0]
         return entry
 
+    # -- streaming job engine (continuous lane scheduling) -----------------
+    #
+    # run() amortizes ONE script over B lanes; every lane retires together,
+    # so a heavy-tailed job mix pays the whole batch's wall clock for its
+    # slowest member (summarize()'s straggler_waste measures the hole).
+    # run_stream() instead drives a QUEUE of J jobs through the B slots:
+    # a jitted step advances every lane a bounded stretch through a
+    # per-lane stage machine (script phases -> drain -> flush, the exact
+    # sequence run() executes), harvests retired lanes into a device-side
+    # results ring, and admits the next queued jobs into the freed slots in
+    # place — donated buffers, no host round trip beyond the scalar
+    # termination check. Per-job summaries are bit-identical to running
+    # each job in a static batch (tests/test_stream.py holds this across
+    # schedulers, faults and quarantine).
+
+    def pack_jobs(self, jobs, fault_armed=None) -> JobPool:
+        """Compile + pack J jobs (event lists or pre-compiled ScriptOps)
+        into one pooled phase table. ``fault_armed``: optional [J] bools —
+        when the runner carries a fault adversary, arms exactly those jobs
+        (per-JOB keys from faults.init_batch_state(J), zeroed where
+        disarmed); default arms all. Without an adversary all keys are 0."""
+        scripts = [j if isinstance(j, ScriptOps)
+                   else compile_events(self.topo, j) for j in jobs]
+        if not scripts:
+            raise ValueError("pack_jobs: empty job list")
+        jcount = len(scripts)
+        kmax = max(s.kind.shape[1] for s in scripts)
+        total = sum(s.num_phases for s in scripts)
+        kind = np.zeros((total, kmax), np.int32)
+        arg0 = np.zeros((total, kmax), np.int32)
+        arg1 = np.zeros((total, kmax), np.int32)
+        do_tick = np.zeros(total, np.int32)
+        start = np.zeros(jcount, np.int32)
+        end = np.zeros(jcount, np.int32)
+        limit = np.zeros(jcount, np.int32)
+        row = 0
+        for j, s in enumerate(scripts):
+            t, k = s.kind.shape
+            start[j], end[j] = row, row + t
+            kind[row:row + t, :k] = np.asarray(s.kind)
+            arg0[row:row + t, :k] = np.asarray(s.arg0)
+            arg1[row:row + t, :k] = np.asarray(s.arg1)
+            do_tick[row:row + t] = np.asarray(s.do_tick)
+            # the static drain's limit is entry-relative (time + max_ticks,
+            # TickKernel._drain_and_flush_with) and a scripted lane enters
+            # the drain at time == its total scripted ticks exactly
+            # (_run_ticks always credits the full stretch), so the absolute
+            # budget is precomputable per job
+            limit[j] = int(np.sum(np.asarray(s.do_tick))) + \
+                self.config.max_ticks
+            row += t
+        if self.faults is not None:
+            keys = np.asarray(self.faults.init_batch_state(jcount))
+            if fault_armed is not None:
+                keys = np.where(np.asarray(fault_armed, bool), keys,
+                                keys.dtype.type(0))
+        else:
+            keys = np.zeros(jcount, np.uint32)
+        return JobPool(kind, arg0, arg1, do_tick, start, end, limit, keys,
+                       self.delay.init_batch_state(jcount))
+
+    def init_stream(self, pool: JobPool,
+                    results_capacity: Optional[int] = None) -> StreamState:
+        """Fresh stream carry for ``pool``: zero counters + an empty results
+        ring of ``results_capacity`` slots (default: one per job, so
+        nothing is ever evicted; smaller rings wrap, keeping the newest)."""
+        r = int(results_capacity) if results_capacity else pool.num_jobs
+        if r < 1:
+            raise ValueError("results_capacity must be >= 1")
+        i = np.int32
+
+        def z(*sh):
+            return np.zeros(sh, np.int32)
+
+        return StreamState(
+            next_job=i(0), jobs_done=i(0), steps=i(0), refills=i(0),
+            lane_steps_live=i(0), lane_steps_total=i(0), res_count=i(0),
+            res_job=np.full(r, -1, np.int32), res_time=z(r), res_error=z(r),
+            res_snap_started=z(r), res_snap_completed=z(r),
+            res_snap_failed=z(r), res_fault_skew=z(r), res_fault_events=z(r),
+            res_admit_step=z(r), res_tokens=z(r, self.topo.n))
+
+    def _stream_step(self, stretch: int, drain_chunk: int, gang: bool):
+        if not hasattr(self, "_stream_jits"):
+            self._stream_jits = {}
+        key = (int(stretch), int(drain_chunk), bool(gang))
+        fn = self._stream_jits.get(key)
+        if fn is None:
+            fn = jax.jit(self._build_stream_step(*key),
+                         donate_argnums=(0, 1))
+            self._stream_jits[key] = fn
+        return fn
+
+    def _build_stream_step(self, stretch: int, drain_chunk: int, gang: bool):
+        """One jitted streaming step: harvest retired lanes -> admit queued
+        jobs into the freed slots -> advance every lane through the
+        per-lane stage machine. The stage machine replays run()'s exact
+        sequence per lane — script phases via _apply_phase (one pooled row
+        per substep), then the drain under the same per-tick condition as
+        TickKernel._drain_and_flush_with, then the max_delay+1 flush —
+        encoded in ``prog_cursor``: rows [start, end) are the script,
+        end = draining, end+1 = flushing, end+2 = retired.
+
+        Pass structure per step: ``stretch`` script substeps (one phase
+        each), then ONE ``drain_chunk``-tick drain slice, then ONE flush
+        pass. Under vmap a masked branch computes and selects for every
+        lane regardless of its stage, so the expensive passes are paid
+        once per STEP, not once per substep — a lane that finishes its
+        script mid-step still enters its drain (and possibly its flush)
+        in the same step, so short jobs retire in one step while the step
+        cost stays ~(stretch + drain_chunk + max_delay) batched ticks."""
+        kern = self.kernel
+        cfg = self.config
+        n = self.topo.n
+        quarantine = self.quarantine
+
+        def lane_pass(s, pool):
+            jmax = pool.job_start.shape[0] - 1
+
+            def stage_of(s):
+                end = pool.job_end[jnp.clip(s.job_id, 0, jmax)]
+                ok = (s.error == 0) if quarantine else jnp.bool_(True)
+                run = (s.job_id >= 0) & ok
+                cur = s.prog_cursor
+                return jnp.where(run & (cur < end), 1,
+                                 jnp.where(run & (cur == end), 2,
+                                           jnp.where(run & (cur == end + 1),
+                                                     3, 0)))
+
+            def script(s):
+                c = jnp.clip(s.prog_cursor, 0, pool.kind.shape[0] - 1)
+                ops = (pool.kind[c], pool.arg0[c], pool.arg1[c],
+                       pool.do_tick[c])
+                s = self._apply_phase(s, ops)
+                return s._replace(prog_cursor=s.prog_cursor + 1)
+
+            def sub(s, _):
+                return lax.cond(stage_of(s) == 1, script,
+                                lambda u: u, s), None
+
+            s, _ = lax.scan(sub, s, None, length=stretch)
+
+            # drain slice: the cursor pins the stage for the whole pass
+            # (only the completion bookkeeping below advances it), so the
+            # entry mask is loop-invariant; error bits fired mid-slice
+            # still stop a quarantined lane via more()'s per-tick check
+            in_drain = stage_of(s) == 2
+            limit = pool.job_limit[jnp.clip(s.job_id, 0, jmax)]
+
+            def more(t):
+                p = in_drain & kern._pending(t) & (t.time < limit)
+                return (p & (t.error == 0)) if quarantine else p
+
+            def one(t, _):
+                return lax.cond(more(t), self._tick_fn, lambda u: u, t), None
+
+            s, _ = lax.scan(one, s, None, length=drain_chunk)
+            done = in_drain & ~more(s)
+            blown = kern._pending(s)
+            if quarantine:
+                blown = blown & (s.error == 0)
+            s = s._replace(
+                error=s.error | jnp.where(done & blown, ERR_TICK_LIMIT,
+                                          0).astype(jnp.int32),
+                prog_cursor=jnp.where(done, s.prog_cursor + 1,
+                                      s.prog_cursor))
+
+            def flush(s):
+                tick = self._tick_fn
+                if quarantine:
+                    def tick(t):
+                        return lax.cond(t.error == 0, self._tick_fn,
+                                        lambda u: u, t)
+                s = lax.fori_loop(0, cfg.max_delay + 1,
+                                  lambda _, t: tick(t), s)
+                return s._replace(prog_cursor=s.prog_cursor + 1)
+
+            return lax.cond(stage_of(s) == 3, flush, lambda u: u, s)
+
+        def step(state, stream, pool):
+            jcount = pool.job_start.shape[0]
+            jmax = jcount - 1
+            rcap = stream.res_job.shape[0]
+            # -- harvest: scatter retired lanes into the results ring ------
+            jid = state.job_id
+            has_job = jid >= 0
+            fin = has_job & (state.prog_cursor
+                             >= pool.job_end[jnp.clip(jid, 0, jmax)] + 2)
+            if quarantine:
+                # a poisoned lane is frozen forever — retire it with its
+                # error bits in the summary and recycle the slot
+                fin = fin | (has_job & (state.error != 0))
+            h = harvest_lane_summaries(state, n)
+            rank = jnp.cumsum(fin.astype(jnp.int32)) - 1
+            pos = (stream.res_count + rank) % rcap
+            widx = jnp.where(fin, pos, rcap)  # rcap is OOB -> row dropped
+
+            def put(ring, vals):
+                return ring.at[widx].set(
+                    jnp.asarray(vals).astype(ring.dtype), mode="drop")
+
+            nfin = jnp.sum(fin, dtype=jnp.int32)
+            stream = stream._replace(
+                res_job=put(stream.res_job, jid),
+                res_time=put(stream.res_time, h["time"]),
+                res_error=put(stream.res_error, h["error"]),
+                res_snap_started=put(stream.res_snap_started,
+                                     h["snap_started"]),
+                res_snap_completed=put(stream.res_snap_completed,
+                                       h["snap_completed"]),
+                res_snap_failed=put(stream.res_snap_failed,
+                                    h["snap_failed"]),
+                res_fault_skew=put(stream.res_fault_skew, h["fault_skew"]),
+                res_fault_events=put(stream.res_fault_events,
+                                     h["fault_events"]),
+                res_admit_step=put(stream.res_admit_step, state.admit_tick),
+                res_tokens=put(stream.res_tokens, h["tokens"]),
+                res_count=stream.res_count + nfin,
+                jobs_done=stream.jobs_done + nfin)
+            # -- admit: reset freed slots, copy in per-job identities ------
+            idle_lane = fin | ~has_job
+            avail = jcount - stream.next_job
+            arank = jnp.cumsum(idle_lane.astype(jnp.int32)) - 1
+            # gang admission = the static-batching baseline on the SAME
+            # executable: refill only when every lane is idle, so whole
+            # cohorts run and retire together (bench's fair comparison)
+            gate = jnp.all(idle_lane) if gang else jnp.bool_(True)
+            admit = idle_lane & (arank < avail) & gate
+            new_jid = stream.next_job + arank
+            new_jidc = jnp.clip(new_jid, 0, jmax)
+            reset = fin | admit
+            state = reset_lanes(state, reset, self.topo, self.config)
+
+            def pick(p, old):
+                # admitted -> the job's pooled row; reset-but-idle -> zeros;
+                # otherwise untouched (reset_lanes leaves these leaves to us)
+                old = jnp.asarray(old)
+                extra = (1,) * (old.ndim - 1)
+                ma = jnp.reshape(admit, admit.shape + extra)
+                mr = jnp.reshape(reset, reset.shape + extra)
+                return jnp.where(ma, jnp.asarray(p)[new_jidc],
+                                 jnp.where(mr, jnp.zeros_like(old), old))
+
+            state = state._replace(
+                delay_state=jax.tree_util.tree_map(
+                    pick, pool.delay_state, state.delay_state),
+                fault_key=pick(pool.fault_key, state.fault_key),
+                job_id=jnp.where(admit, new_jid, jnp.where(fin, -1, jid)),
+                prog_cursor=jnp.where(admit, pool.job_start[new_jidc],
+                                      jnp.where(reset, 0,
+                                                state.prog_cursor)),
+                admit_tick=jnp.where(admit, stream.steps,
+                                     jnp.where(reset, 0, state.admit_tick)))
+            stream = stream._replace(
+                next_job=stream.next_job + jnp.sum(admit, dtype=jnp.int32),
+                refills=stream.refills + jnp.sum(admit & fin,
+                                                 dtype=jnp.int32))
+            # -- advance: every lane runs one pass of the stage machine ----
+            # occupancy accounting first: a lane is live this step iff it
+            # holds a job after admission; the denominator charges the
+            # full batch whenever ANY lane is live (idle slots beside
+            # running ones are exactly the waste being measured), and the
+            # trailing all-idle step before the host notices completion
+            # charges nothing
+            live = jnp.sum(state.job_id >= 0, dtype=jnp.int32)
+            stream = stream._replace(
+                steps=stream.steps + 1,
+                lane_steps_live=stream.lane_steps_live + live,
+                lane_steps_total=stream.lane_steps_total + jnp.where(
+                    live > 0, jnp.int32(self.batch), jnp.int32(0)))
+            state = jax.vmap(lane_pass, in_axes=(0, None))(state, pool)
+            return state, stream
+
+        return step
+
+    def run_stream(self, jobs, *, stretch: int = 4, drain_chunk: int = 32,
+                   admission: str = "stream",
+                   results_capacity: Optional[int] = None,
+                   state: Optional[DenseState] = None,
+                   stream: Optional[StreamState] = None,
+                   max_steps: int = 1_000_000, checkpoint: Optional[str] = None,
+                   checkpoint_every: int = 0,
+                   kill_after_saves: Optional[int] = None):
+        """Drive a queue of jobs through the B lane slots; returns the final
+        ``(state, stream)``. ``jobs``: a JobPool (pack_jobs) or a list of
+        event lists / ScriptOps. ``admission``: 'stream' (default) refills
+        slots the moment they retire; 'gang' only refills when EVERY slot
+        is idle — the static-batching baseline on the same executable.
+
+        Progress per host iteration is one jitted step (harvest + admit +
+        ``stretch`` script phases, one ``drain_chunk``-tick drain slice
+        and one flush pass per lane, donated carry); the only device reads
+        are the termination scalars. Every running lane provably advances
+        each step (script rows and the flush are fixed-length; the drain
+        budget is finite), so the queue terminates; ``max_steps`` merely
+        guards against misconfiguration.
+
+        Checkpointing: with ``checkpoint`` + ``checkpoint_every`` k, every
+        k-th step atomically saves the combined ``(state, stream)`` pytree
+        (utils/checkpoint.save_state — format v6). Resume by loading with
+        ``like=(runner.init_batch(), runner.init_stream(pool))`` and
+        passing ``state=``/``stream=`` back in; the continuation is
+        bit-exact because admission order, per-job streams and the results
+        ring all live in the saved carry. ``kill_after_saves``: stop right
+        after that many saves (preemption drills; tests)."""
+        from chandy_lamport_tpu.utils.checkpoint import save_state
+
+        if admission not in ("stream", "gang"):
+            raise ValueError(f"unknown admission mode {admission!r}")
+        if stretch < 1 or drain_chunk < 1:
+            raise ValueError("stretch and drain_chunk must be >= 1")
+        pool = jobs if isinstance(jobs, JobPool) else self.pack_jobs(jobs)
+        if state is None:
+            state = self.init_batch()
+        if stream is None:
+            stream = self.init_stream(pool, results_capacity)
+        step = self._stream_step(stretch, drain_chunk, admission == "gang")
+        pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
+        jcount = pool.num_jobs
+        saves = 0
+        for _ in range(int(max_steps)):
+            state, stream = step(state, stream, pool_dev)
+            done = int(stream.jobs_done)
+            if (checkpoint and checkpoint_every
+                    and int(stream.steps) % int(checkpoint_every) == 0):
+                save_state(checkpoint, (state, stream),
+                           meta={"stream_steps": int(stream.steps),
+                                 "jobs_done": done})
+                saves += 1
+                if kill_after_saves is not None \
+                        and saves >= int(kill_after_saves):
+                    return state, stream
+            if done >= jcount:
+                return state, stream
+        raise RuntimeError(
+            f"run_stream: {jcount - done} of {jcount} jobs unfinished after "
+            f"{max_steps} steps — raise max_steps (or a lane is stuck, "
+            f"which the stage machine should make impossible)")
+
+    @staticmethod
+    def stream_results(stream: StreamState) -> List[dict]:
+        """The results ring as host-side per-job rows, sorted by job id
+        (completion order is admission-dependent; the sort makes
+        stream-vs-static comparison direct). A ring smaller than the job
+        count keeps only the newest rows — the oldest ``res_count -
+        capacity`` are evicted; summarize_stream reports the count."""
+        from chandy_lamport_tpu.core.state import decode_error_bits
+
+        host = jax.device_get(stream)
+        rcap = int(np.shape(host.res_job)[0])
+        rows = []
+        for i in range(min(int(host.res_count), rcap)):
+            err = int(host.res_error[i])
+            rows.append({
+                "job": int(host.res_job[i]),
+                "time": int(host.res_time[i]),
+                "error": err,
+                "errors_decoded": decode_error_bits(err),
+                "snapshots_started": int(host.res_snap_started[i]),
+                "snapshots_completed": int(host.res_snap_completed[i]),
+                "snapshots_failed": int(host.res_snap_failed[i]),
+                "fault_skew": int(host.res_fault_skew[i]),
+                "fault_events": int(host.res_fault_events[i]),
+                "admit_step": int(host.res_admit_step[i]),
+                "tokens": np.asarray(host.res_tokens[i]).astype(int).tolist(),
+            })
+        rows.sort(key=lambda r: r["job"])
+        return rows
+
+    def summarize_stream(self, stream: StreamState) -> dict:
+        """Host-side stream counters (utils/metrics.stream_counters:
+        occupancy, refills, straggler-wasted substeps) + results-ring
+        accounting."""
+        from chandy_lamport_tpu.utils.metrics import stream_counters
+
+        host = jax.device_get(stream)
+        d = stream_counters(host)
+        rcap = int(np.shape(host.res_job)[0])
+        d["results_capacity"] = rcap
+        d["results_evicted"] = max(0, int(host.res_count) - rcap)
+        return d
+
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch
     #    axis these lower to XLA collectives over ICI) --------------------
 
@@ -640,6 +1086,7 @@ class BatchedRunner:
         from chandy_lamport_tpu.utils.metrics import (
             or_reduce,
             snapshot_lifecycle,
+            straggler_waste,
         )
 
         bits = int(or_reduce(state.error))
@@ -648,6 +1095,10 @@ class BatchedRunner:
             "instances": int(state.time.shape[0]),
             "total_ticks": int(jnp.sum(state.time)),
             "max_time": int(jnp.max(state.time)),
+            # fraction of the batch's lane-tick budget burned waiting for
+            # the slowest lane (utils/metrics.straggler_waste) — the hole
+            # run_stream's continuous admission exists to reclaim
+            "straggler_waste": round(float(straggler_waste(state)), 4),
             "error_lanes": int(jnp.sum(state.error != 0)),
             # which bits fired across ALL lanes (int(max) would drop bits);
             # the short names ride along so no consumer has to decode the

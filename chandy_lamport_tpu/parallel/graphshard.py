@@ -1099,6 +1099,11 @@ class GraphShardedRunner:
             snap_failed=np.asarray(h.snap_failed),
             snap_done_time=np.asarray(h.snap_done_time),
             stale_markers=np.int32(0),
+            # the sharded runner simulates one instance end to end — no job
+            # streaming; reassemble with the idle-lane defaults
+            job_id=np.int32(-1),
+            prog_cursor=np.int32(0),
+            admit_tick=np.int32(0),
             error=np.asarray(h.error),
         )
 

@@ -58,7 +58,13 @@ from chandy_lamport_tpu.core.state import DenseState
 #       widens to [7] (marker-plane classes); a version-4 checkpoint is
 #       seven leaves short with a mis-shaped fault_counts, so it errors
 #       here rather than misdecode
-_FORMAT_VERSION = 5
+#   6 — PR-6 streaming-engine leaves (job_id/prog_cursor/admit_tick,
+#       core/state.py): per-lane job identity joins the carry so a
+#       streaming run (parallel/batch.run_stream) checkpointed mid-queue
+#       resumes its admission state bit-exactly; a version-5 checkpoint is
+#       three leaves short and errors here rather than misalign every
+#       leaf after stale_markers
+_FORMAT_VERSION = 6
 # every layout change so far has been breaking (leaves added or reshaped),
 # so exactly one version is live; kept as a range so a future
 # backward-compatible revision can widen the floor without touching the

@@ -18,7 +18,7 @@ otherwise reduce everything.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Any, Dict
 
 import jax.numpy as jnp
 
@@ -122,6 +122,49 @@ def progress_counters(state: DenseState, cfg: SimConfig,
     }
 
 
+def straggler_waste(state: DenseState) -> jnp.ndarray:
+    """Fraction of the batch's tick capacity burned waiting for the slowest
+    lane: ``1 - mean(time) / max(time)`` over whatever batching the state
+    carries (0.0 for a single instance, or when nothing ticked). Every
+    dispatch runs until the slowest lane converges, so a batch whose lanes
+    quiesce at a mean of 85 ticks but whose max is 105 spent ~19% of its
+    lane-tick budget re-checking finished lanes — the dispersion the
+    streaming engine (parallel/batch.run_stream) exists to reclaim by
+    refilling retired lanes in place."""
+    t = jnp.asarray(state.time, jnp.float32)
+    mx = jnp.max(t)
+    return jnp.where(mx > 0, 1.0 - jnp.mean(t) / jnp.maximum(mx, 1.0), 0.0)
+
+
+def stream_occupancy(stream) -> float:
+    """Fraction of lane-steps that held a live job during a ``run_stream``
+    drive (StreamState counters; one lane-step = one slot for one stream
+    step): 1.0 means every slot held working jobs the whole run; gang
+    (static-batch) admission of heavy-tailed jobs shows the straggler
+    hole directly here."""
+    total = int(stream.lane_steps_total)
+    return float(int(stream.lane_steps_live)) / total if total else 0.0
+
+
+def stream_counters(stream) -> Dict[str, Any]:
+    """Host-side scalars of a StreamState (parallel/batch.run_stream):
+    jobs admitted/harvested, refill count (admissions into a recycled
+    slot, i.e. beyond each lane's first job), occupancy, and the
+    straggler-wasted lane-steps the occupancy complement counts."""
+    total = int(stream.lane_steps_total)
+    live = int(stream.lane_steps_live)
+    return {
+        "steps": int(stream.steps),
+        "jobs_admitted": int(stream.next_job),
+        "jobs_done": int(stream.jobs_done),
+        "refills": int(stream.refills),
+        "occupancy": round(live / total, 4) if total else 0.0,
+        "lane_steps_live": live,
+        "lane_steps_total": total,
+        "straggler_wasted_steps": total - live,
+    }
+
+
 def instance_footprint_bytes(num_nodes: int, num_edges: int,
                              cfg: SimConfig) -> int:
     """Per-instance HBM bytes of a DenseState (excluding delay state):
@@ -158,8 +201,9 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
                  + e * (1 + win * 2) + e * (1 + 4 + 4)
                  + 5 * 4 + 1)
     # time/next_sid/error + fault_key/fault_skew/fault_counts[7] +
-    # stale_markers, completed
-    scalars = 4 * 3 + 4 * 10 + s * 4
+    # stale_markers, completed, and the streaming-engine job identity
+    # (job_id/prog_cursor/admit_tick)
+    scalars = 4 * 3 + 4 * 10 + s * 4 + 4 * 3
     return queues + nodes + rec_log + snaps + scalars
 
 

@@ -47,7 +47,7 @@ def run_main(monkeypatch, capsys, argv, outcomes, platform="tpu"):
     spawn = ScriptedSpawn(outcomes)
     monkeypatch.setattr(bench, "_spawn", spawn)
     monkeypatch.setattr(bench, "_find_live_platform",
-                        lambda args: (platform, {}))
+                        lambda args: (platform, {}, False))
     rc = bench.main(argv)
     assert rc == 0  # the orchestrator always exits 0 with one JSON line
     out = capsys.readouterr().out.strip().splitlines()

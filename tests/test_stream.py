@@ -1,0 +1,203 @@
+"""Streaming engine (continuous lane scheduling): stream-vs-static parity.
+
+The oracle for ``BatchedRunner.run_stream`` is the static path itself: a job
+that streams through whatever slot the admitter hands it must produce the
+SAME per-job summary — time, error bits, final token vector, snapshot
+lifecycle — as that job run alone on the static ``run()`` path, bit for
+bit. The per-lane tick sequence is slot-independent because every piece of
+per-job context (script cursor, fault stream key, delay-sampler state)
+lives in the lane's DenseState leaves and is reset + reseeded from the
+JobPool row at admission (ops/tick.reset_lanes, parallel/batch docstring).
+
+Tier-1 keeps the shapes tiny (ring-8, a handful of jobs) and shares one
+module-scoped runner so the jitted stream step compiles once; the deep
+heterogeneous sweep (J=48 through B=16, both schedulers, fault-armed
+subset) is ``slow``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import ring_topology, stream_jobs
+from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner, compile_events
+from chandy_lamport_tpu.utils import checkpoint as ckpt_mod
+from chandy_lamport_tpu.utils.checkpoint import (
+    CheckpointError,
+    load_state,
+    save_state,
+)
+
+TOPO = ring_topology(8)
+CFG = SimConfig.for_workload(snapshots=4, max_recorded=128)
+J, B = 8, 4
+
+
+def _delay():
+    return make_fast_delay("hash", 11)
+
+
+def _static_rows(sched, jobs, fault_key=None, faults=None, quarantine=False):
+    """Oracle: each job alone on the static run() path (a batch-J runner
+    sliced to one lane, so init/leaves match the streaming admitter's
+    fresh-template reset exactly)."""
+    r = BatchedRunner(TOPO, CFG, _delay(), len(jobs), scheduler=sched,
+                      faults=faults, quarantine=quarantine)
+    st = r.init_batch()
+    if fault_key is not None:
+        st = st._replace(fault_key=np.asarray(fault_key))
+    rows = []
+    for j, ev in enumerate(jobs):
+        sj = jax.tree_util.tree_map(lambda x: x[j:j + 1], st)
+        out = r.run(sj, compile_events(r.topo, ev))
+        rows.append({
+            "job": j,
+            "time": int(out.time[0]),
+            "error": int(out.error[0]),
+            "tokens": np.asarray(out.tokens[0]).astype(int).tolist(),
+            "snapshots_started": int(np.sum(np.asarray(out.started[0]))),
+        })
+    return rows
+
+
+def _assert_rows_match(stream_rows, static_rows):
+    assert len(stream_rows) == len(static_rows)
+    for a, b in zip(stream_rows, static_rows):
+        for k in ("job", "time", "error", "tokens", "snapshots_started"):
+            assert a[k] == b[k], (a["job"], k, a[k], b[k])
+
+
+@pytest.fixture(scope="module")
+def sync_runner():
+    return BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync")
+
+
+@pytest.fixture(scope="module")
+def jobs():
+    return stream_jobs(TOPO, J, seed=5, base_phases=3, max_phases=12)
+
+
+@pytest.fixture(scope="module")
+def pool(sync_runner, jobs):
+    return sync_runner.pack_jobs(jobs)
+
+
+@pytest.fixture(scope="module")
+def sync_stream(sync_runner, pool):
+    state, stream = sync_runner.run_stream(pool, stretch=3, drain_chunk=16)
+    return (sync_runner.stream_results(stream),
+            sync_runner.summarize_stream(stream))
+
+
+def test_stream_drains_queue_and_recycles_slots(sync_stream):
+    rows, summ = sync_stream
+    assert summ["jobs_done"] == J
+    assert summ["jobs_admitted"] == J
+    assert len(rows) == J
+    # every admission beyond each slot's first is a refill
+    assert summ["refills"] == J - B
+    assert 0.0 < summ["occupancy"] <= 1.0
+    assert summ["results_evicted"] == 0
+
+
+def test_stream_vs_static_parity_sync(sync_stream, jobs):
+    _assert_rows_match(sync_stream[0], _static_rows("sync", jobs))
+
+
+def test_gang_admission_same_results(sync_runner, pool, sync_stream):
+    # gang = static batching on the same executable: identical per-job
+    # rows (admit steps differ — that's the whole point), lower occupancy
+    _, stream = sync_runner.run_stream(pool, stretch=3, drain_chunk=16,
+                                       admission="gang")
+    rows = sync_runner.stream_results(stream)
+    for a, b in zip(sync_stream[0], rows):
+        assert a == {**b, "admit_step": a["admit_step"]}
+    summ = sync_runner.summarize_stream(stream)
+    assert summ["jobs_done"] == J
+    assert summ["occupancy"] <= sync_stream[1]["occupancy"]
+
+
+def test_checkpoint_v6_kill_and_resume_mid_queue(sync_runner, pool,
+                                                 tmp_path):
+    # same stretch/drain_chunk as the parity fixture -> the jitted step is
+    # already compiled; the save/kill/load/finish trip must land on the
+    # byte-identical final (state, stream) carry, results ring included
+    ref_state, ref_stream = sync_runner.run_stream(pool, stretch=3,
+                                                   drain_chunk=16)
+    path = str(tmp_path / "stream.npz")
+    _, killed = sync_runner.run_stream(pool, stretch=3, drain_chunk=16,
+                                       checkpoint=path, checkpoint_every=2,
+                                       kill_after_saves=2)
+    assert int(killed.jobs_done) < J, "kill landed after the queue drained"
+    like = (sync_runner.init_batch(), sync_runner.init_stream(pool))
+    (state, stream), meta = load_state(path, like)
+    assert meta["jobs_done"] == int(stream.jobs_done)
+    state, stream = sync_runner.run_stream(pool, stretch=3, drain_chunk=16,
+                                           state=state, stream=stream)
+    assert (sync_runner.stream_results(stream)
+            == sync_runner.stream_results(ref_stream))
+    for a, b in zip(jax.tree_util.tree_leaves((ref_state, ref_stream)),
+                    jax.tree_util.tree_leaves((state, stream))):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
+    # the supported range in the error must have widened to v6 (the
+    # streaming-engine format): an operator holding a too-NEW file learns
+    # both sides of the mismatch
+    path = str(tmp_path / "v99.npz")
+    tree = {"x": np.zeros(3, np.int32)}
+    monkeypatch.setattr(ckpt_mod, "_FORMAT_VERSION", 99)
+    save_state(path, tree)
+    monkeypatch.undo()
+    with pytest.raises(CheckpointError,
+                       match=r"version 99.*supported version range "
+                             r"v\d+\.\.v6"):
+        load_state(path, tree)
+
+
+@pytest.mark.slow
+def test_stream_vs_static_parity_exact():
+    runner = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="exact")
+    jobs = stream_jobs(TOPO, J, seed=5, base_phases=3, max_phases=12)
+    _, stream = runner.run_stream(runner.pack_jobs(jobs), stretch=3,
+                                  drain_chunk=16)
+    _assert_rows_match(runner.stream_results(stream),
+                       _static_rows("exact", jobs))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sched", ["exact", "sync"])
+def test_stream_deep_heterogeneous_parity(sched):
+    # the acceptance sweep: J=48 heavy-tailed jobs through B=16 slots with
+    # a fault adversary armed on every third job + quarantine — per-job
+    # summaries bit-identical to each job alone on the static path, with
+    # the SAME per-job fault stream (pool fault_key replayed wherever the
+    # job lands)
+    jcount, slots = 48, 16
+    faults = JaxFaults(7, drop_rate=0.05, dup_rate=0.05,
+                       max_delay=_delay().max_delay)
+    runner = BatchedRunner(TOPO, CFG, _delay(), slots, scheduler=sched,
+                           faults=faults, quarantine=True)
+    jobs = stream_jobs(TOPO, jcount, seed=6, base_phases=3, max_phases=16)
+    armed = np.arange(jcount) % 3 == 0
+    pool = runner.pack_jobs(jobs, fault_armed=armed)
+    _, stream = runner.run_stream(pool, stretch=4, drain_chunk=16)
+    rows = runner.stream_results(stream)
+    summ = runner.summarize_stream(stream)
+    assert summ["jobs_done"] == jcount
+    assert summ["refills"] == jcount - slots
+    _assert_rows_match(rows, _static_rows(sched, jobs,
+                                          fault_key=pool.fault_key,
+                                          faults=faults, quarantine=True))
+    # disarmed jobs never see the adversary, whichever slot they streamed
+    # through
+    for r in rows:
+        if not armed[r["job"]]:
+            assert r["error"] == 0

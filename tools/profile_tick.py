@@ -234,6 +234,73 @@ def main() -> None:
         print(f"  {name:<12} {g * 1e3:10.3f} {m * 1e3:10.3f} "
               f"{m / g:7.2f}x", file=sys.stderr)
 
+    # ---- refill: the streaming engine's harvest + admit tax, measured ---
+    # Per-step cost of continuous lane scheduling (parallel/batch.
+    # _build_stream_step): the full jitted stream step — harvest retiring
+    # lanes into the results ring, admit queued jobs into the freed slots,
+    # then `stretch` script phases + one drain slice + one flush pass per
+    # lane — next to its two refill-only primitives in isolation:
+    # harvest_lane_summaries (the [B] per-lane summary reductions) and
+    # reset_lanes (the masked fresh-template scatter over every state
+    # leaf). The deltas bound what slot recycling adds on top of the
+    # phase work the step would do anyway (~stretch+chunk+flush ticks).
+    from chandy_lamport_tpu.models.workloads import stream_jobs
+    from chandy_lamport_tpu.ops.tick import (
+        harvest_lane_summaries,
+        reset_lanes,
+    )
+
+    r_stretch, r_chunk = 4, 8
+    jobs = stream_jobs(spec, 2 * args.batch, seed=17, base_phases=4,
+                       max_phases=16)
+    pool = runner.pack_jobs(jobs)
+    pool_dev = jax.tree_util.tree_map(jax.numpy.asarray, pool)
+    half = jax.numpy.arange(args.batch) % 2 == 0
+
+    jharv = jax.jit(lambda t: harvest_lane_summaries(t, runner.topo.n))
+    jreset = jax.jit(lambda t: reset_lanes(t, half, runner.topo, cfg),
+                     donate_argnums=0)
+    sstep = runner._stream_step(r_stretch, r_chunk, False)
+
+    rtimings = {}
+    st = runner.init_batch_device()
+    out = jharv(st)                            # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jharv(st)
+    jax.block_until_ready(out)
+    rtimings["harvest"] = (time.perf_counter() - t0) / reps
+
+    st = jreset(runner.init_batch_device())    # compile + warm
+    jax.block_until_ready(st)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st = jreset(st)
+    jax.block_until_ready(st)
+    rtimings["lane-reset"] = (time.perf_counter() - t0) / reps
+
+    st, sm = runner.init_batch(), runner.init_stream(pool)
+    st, sm = sstep(st, sm, pool_dev)           # compile + warm
+    jax.block_until_ready(st.time)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        st, sm = sstep(st, sm, pool_dev)
+    jax.block_until_ready(st.time)
+    rtimings["stream-step"] = (time.perf_counter() - t0) / reps
+
+    work = r_stretch + r_chunk + cfg.max_delay + 1
+    print(f"refill (streaming engine, stretch={r_stretch} "
+          f"drain_chunk={r_chunk}):", file=sys.stderr)
+    print(f"  harvest summaries        "
+          f"{rtimings['harvest'] * 1e3:9.3f} ms", file=sys.stderr)
+    print(f"  lane reset (half mask)   "
+          f"{rtimings['lane-reset'] * 1e3:9.3f} ms", file=sys.stderr)
+    print(f"  full stream step         "
+          f"{rtimings['stream-step'] * 1e3:9.3f} ms "
+          f"(~{work} lane-ticks of phase work; bare tick "
+          f"{per_tick * 1e3:.3f} ms)", file=sys.stderr)
+
     # ---- fault-adversary overhead: the compiled-in-zero-cost claim, -----
     # measured. Three kernels at the same shape: faults=None (the
     # uninstrumented trace), a zero-rate JaxFaults (instrumentation in the
