@@ -49,15 +49,19 @@ def make_backend(name: str, topology: TopologySpec, delay_model: DelayModel,
             sim.add_link(src, dest)
         return sim
     if name == "jax":
-        if trace:
-            raise ValueError(
-                "trace=True is only supported on the parity backend — "
-                "structured per-event capture is incompatible with the jit "
-                "hot loop (SURVEY.md §5); use backend='parity' for traces")
         from chandy_lamport_tpu.core.dense import DenseSim
 
+        jtrace = None
+        if trace:
+            # the device flight recorder (utils/tracing.JaxTrace): events
+            # are captured INSIDE the jitted kernels as packed ring writes
+            # and decoded host-side into the same epoch format the parity
+            # logger prints — sim.trace.pretty() on either backend
+            from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+            jtrace = JaxTrace()
         return DenseSim(topology, delay_model, config or SimConfig(),
-                        exact_impl=exact_impl, faults=faults)
+                        exact_impl=exact_impl, faults=faults, trace=jtrace)
     raise ValueError(f"unknown backend {name!r} (expected 'parity' or 'jax')")
 
 

@@ -237,6 +237,18 @@ def _parser() -> argparse.ArgumentParser:
                         "harvest/refill points")
     p.add_argument("--drain-chunk", type=int, default=32,
                    help="--stream: drain ticks per lane substep slice")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the device flight recorder (utils/tracing.py) "
+                        "during the measurement; the row gains trace_"
+                        "capacity/trace_events/trace_dropped plus a "
+                        "trace_overhead_pct computed against one untraced "
+                        "baseline run at the same shape")
+    p.add_argument("--trace-capacity", type=int, default=0, metavar="K",
+                   help="ring slots per lane (0 = JaxTrace default when "
+                        "--trace is set); implies --trace when > 0")
+    p.add_argument("--telemetry", metavar="PATH",
+                   help="append the result row as schema-versioned JSONL "
+                        "telemetry (tools/analyze.py --telemetry)")
     p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     p.add_argument("--probe", action="store_true", help=argparse.SUPPRESS)
     return p
@@ -398,6 +410,11 @@ def run_worker(args) -> int:
                                  snapshot_every=args.snapshot_every)
     if args.capacity:
         cfg = dataclasses.replace(cfg, queue_capacity=args.capacity)
+    trace = None
+    if args.trace or args.trace_capacity:
+        from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+        trace = JaxTrace(capacity=args.trace_capacity)
 
     if args.graphshard:
         return run_graphshard_worker(args, dev, spec, cfg)
@@ -411,7 +428,7 @@ def run_worker(args) -> int:
                                exact_impl=args.exact_impl,
                                auto_layouts=args.layouts == "auto",
                                megatick=args.megatick,
-                               queue_engine=args.queue_engine)
+                               queue_engine=args.queue_engine, trace=trace)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -519,6 +536,44 @@ def run_worker(args) -> int:
             f"per batched tick) -> {node_ticks[-1] / dt / 1e6:.2f}M node-ticks/s")
 
     best = max(nt / dt for nt, dt in zip(node_ticks, times))
+    trace_extra = {}
+    if trace is not None:
+        # trace overhead: one untraced run at the same shape (compile is
+        # a second executable, but the persistent cache absorbs repeats).
+        # A separate runner — trace_capacity=0 compiles every trace op away
+        # (the bit-identity guarantee tests/test_trace.py pins down).
+        base_cfg = dataclasses.replace(runner.config, trace_capacity=0)
+        base = BatchedRunner(spec, base_cfg, make_fast_delay(args.delay, 17),
+                             batch=args.batch, scheduler=args.scheduler,
+                             exact_impl=args.exact_impl,
+                             auto_layouts=args.layouts == "auto",
+                             megatick=args.megatick,
+                             queue_engine=args.queue_engine)
+        fmtb = base.prepare_storm(prog)
+        fb = base.run_storm(base.init_batch_device(formats=fmtb), prog)
+        jax.block_until_ready(fb)
+        del fb  # warmup done; same double-residency guard
+        sb = base.init_batch_device(formats=base.storm_state_formats())
+        jax.block_until_ready(sb)
+        t0 = time.perf_counter()
+        fb = base.run_storm(sb, prog)
+        jax.block_until_ready(fb)
+        dt0 = time.perf_counter() - t0
+        base_rate = (int(np.asarray(jax.device_get(fb.time)).sum())
+                     * topo.n / dt0)
+        del sb, fb
+        trace_extra = {
+            "trace_capacity": runner.config.trace_capacity,
+            "trace_events": summary["trace_events"],
+            "trace_dropped": summary["trace_dropped"],
+            # recording-rate cost vs the compiled-away baseline; negative
+            # values are timing noise, not a speedup
+            "trace_overhead_pct": round((base_rate / best - 1.0) * 100, 1),
+            "untraced_node_ticks_per_sec": round(base_rate, 1),
+        }
+        log(f"trace overhead: {trace_extra['trace_overhead_pct']}% "
+            f"(untraced {base_rate / 1e6:.2f}M vs traced "
+            f"{best / 1e6:.2f}M node-ticks/s)")
     result = {
         "metric": "node_ticks_per_sec_per_chip",
         "value": round(best, 1),
@@ -559,6 +614,7 @@ def run_worker(args) -> int:
             "snapshot_every": args.snapshot_every}
            if (args.snapshot_timeout or args.snapshot_every) else {}),
     }
+    result.update(trace_extra)
     result.update(mem)
     if dev.platform != "tpu":
         # an honest CPU/fallback number must not read as the chip's
@@ -572,8 +628,24 @@ def run_worker(args) -> int:
             + "measured TPU rows live in BASELINE_MEASURED.jsonl "
               "/ BASELINE.md")
         result.update(_best_recorded_tpu())
+    _write_telemetry(args, "bench_run", result)
     print(json.dumps(result), flush=True)
     return 0
+
+
+def _write_telemetry(args, kind: str, row: dict) -> None:
+    """Append the row as schema-versioned JSONL (utils/tracing.
+    TelemetryWriter) when --telemetry is set. Best-effort — telemetry
+    must never fail a measurement that already succeeded."""
+    if not getattr(args, "telemetry", None):
+        return
+    try:
+        from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+        with TelemetryWriter(args.telemetry) as tw:
+            tw.write(kind, row)
+    except OSError as exc:
+        log(f"telemetry not written: {exc}")
 
 
 def _best_recorded_tpu() -> dict:
@@ -624,11 +696,16 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
     from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
 
+    trace = None
+    if args.trace or args.trace_capacity:
+        from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+        trace = JaxTrace(capacity=args.trace_capacity)
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            megatick=args.megatick,
-                           queue_engine=args.queue_engine)
+                           queue_engine=args.queue_engine, trace=trace)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
                        tail_alpha=1.1, max_phases=max(args.phases, 8))
@@ -705,6 +782,12 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
         "stream_steps": ss["steps"],
         "gang_steps": sg["steps"],
     }
+    if trace is not None:
+        from chandy_lamport_tpu.utils.tracing import trace_counts
+
+        tr_rec, tr_drop = trace_counts(state)
+        result["trace_capacity"] = runner.config.trace_capacity
+        result["trace_events"], result["trace_dropped"] = tr_rec, tr_drop
     result.update(mem)
     if dev.platform != "tpu":
         deliberate = (os.environ.get("CLSIM_PLATFORM") == "cpu"
@@ -714,6 +797,7 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
              else "non-TPU fallback (device tunnel down?); ")
             + "stream-vs-gang speedup is platform-relative, not a chip "
               "throughput claim")
+    _write_telemetry(args, "bench_stream", result)
     print(json.dumps(result), flush=True)
     return 0
 
@@ -845,6 +929,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         result["note"] = ("non-TPU graphshard row (CPU-mesh relative cost "
                          "only); measured TPU rows live in "
                          "BASELINE_MEASURED.jsonl / BASELINE.md")
+    _write_telemetry(args, "bench_graphshard", result)
     print(json.dumps(result), flush=True)
     return 0
 

@@ -8,6 +8,10 @@ this covers the same ground and the scale workflows the reference lacks:
          parser)
   test   run every reference golden case end-to-end and report pass/fail —
          the CLI twin of the pytest suite
+  trace  run a fixture pair with the device flight recorder armed
+         (utils/tracing.py): print the decoded protocol timeline in the
+         reference Logger's format, optionally export Perfetto JSON and
+         schema-versioned telemetry JSONL
   storm  batched scale run (instances x storm program) with aggregate
          metrics, optional checkpointing
   stream continuous lane scheduling: drive a queue of J heterogeneous jobs
@@ -104,6 +108,38 @@ def _cmd_test(args) -> int:
     return 1 if failures else 0
 
 
+def _cmd_trace(args) -> int:
+    """Run a fixture with the flight recorder armed on the jax backend and
+    print the decoded timeline — what the ``run --trace`` path does on the
+    parity backend, but captured INSIDE the jitted kernels."""
+    from chandy_lamport_tpu.api import run_events_file
+
+    snaps, sim = run_events_file(args.topology, args.events,
+                                 backend="jax", seed=args.seed, trace=True)
+    recorded, dropped = sim.trace.counts()
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(sim.trace.perfetto(), f)
+        print(f"wrote perfetto trace: {args.perfetto}", file=sys.stderr)
+    if args.telemetry:
+        from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+        with TelemetryWriter(args.telemetry) as tw:
+            tw.write("trace_run", {
+                "topology": args.topology, "events": args.events,
+                "seed": args.seed, "snapshots": len(snaps),
+                "trace_events": recorded, "trace_dropped": dropped})
+            for ev in sim.trace.events:
+                tw.write("event", {"tick": ev.tick, "event": ev.kind_name,
+                                   "actor": ev.actor,
+                                   "payload": ev.payload})
+        print(f"wrote telemetry: {args.telemetry}", file=sys.stderr)
+    print(sim.trace.pretty())
+    print(f"# {recorded} events recorded, {dropped} dropped",
+          file=sys.stderr)
+    return 0
+
+
 def _cmd_storm(args) -> int:
     import numpy as np
 
@@ -160,12 +196,17 @@ def _cmd_storm(args) -> int:
     # an armed adversary quarantines by default: an injured lane freezes
     # with its decoded bits surfaced instead of poisoning the aggregates
     quarantine = args.quarantine or faults is not None
+    trace = None
+    if args.trace or args.trace_capacity:
+        from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+        trace = JaxTrace(capacity=args.trace_capacity)
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            check_every=args.check_every,
                            megatick=args.megatick, faults=faults,
-                           quarantine=quarantine)
+                           quarantine=quarantine, trace=trace)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
         snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
@@ -239,11 +280,22 @@ def _cmd_storm(args) -> int:
         counters["lane_errors"] = {
             int(i): decode_error_bits(int(errs[i]))
             for i in np.flatnonzero(errs)[:16]}
+    if trace is not None:
+        from chandy_lamport_tpu.utils.tracing import trace_counts
+
+        tr_rec, tr_drop = trace_counts(final)
+        counters["trace_events"], counters["trace_dropped"] = tr_rec, tr_drop
     if args.checkpoint:
         save_state(args.checkpoint, final,
                    meta={**meta_base, "next_phase": args.phases,
                          "drained": True})
         counters["checkpoint"] = args.checkpoint
+    if args.telemetry:
+        from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+        with TelemetryWriter(args.telemetry) as tw:
+            tw.write("storm_run", {**meta_base, **counters})
+        counters["telemetry"] = args.telemetry
     print(json.dumps(counters))
     if counters["error_bits"] == 0:
         return 0
@@ -289,9 +341,15 @@ def _cmd_stream(args) -> int:
             args.fault_seed if args.fault_seed is not None else args.seed,
             drop_rate=args.fault_drop, dup_rate=args.fault_dup,
             jitter_rate=args.fault_jitter)
+    trace = None
+    if args.trace or args.trace_capacity:
+        from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+        trace = JaxTrace(capacity=args.trace_capacity)
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
-                           faults=faults, quarantine=faults is not None)
+                           faults=faults, quarantine=faults is not None,
+                           trace=trace)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=args.seed,
                        base_phases=args.base_phases,
@@ -334,6 +392,19 @@ def _cmd_stream(args) -> int:
         # straight off the JSON row, like storm's lane_errors
         row["job_errors"] = {r["job"]: r["errors_decoded"]
                              for r in errored[:16]}
+    if trace is not None:
+        from chandy_lamport_tpu.utils.tracing import trace_counts
+
+        tr_rec, tr_drop = trace_counts(state)
+        row["trace_events"], row["trace_dropped"] = tr_rec, tr_drop
+    if args.telemetry:
+        from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+        with TelemetryWriter(args.telemetry) as tw:
+            tw.write("stream_run", row)
+            for r in runner.stream_results(stream):
+                tw.write("stream_job", r)
+        row["telemetry"] = args.telemetry
     print(json.dumps(row))
     # an armed adversary EXPECTS casualties (quarantined + harvested with
     # their error bits); without one any errored job is a failure
@@ -381,6 +452,21 @@ def main(argv=None) -> int:
                          "goldens replay the Go-exact stream, which 'wave' "
                          "refuses by design)")
     pt.set_defaults(fn=_cmd_test)
+
+    pv = sub.add_parser("trace", help="run a fixture with the device flight "
+                                      "recorder armed; print the decoded "
+                                      "timeline")
+    pv.add_argument("topology")
+    pv.add_argument("events")
+    pv.add_argument("--seed", type=int, default=REFERENCE_TEST_SEED + 1)
+    pv.add_argument("--perfetto", metavar="PATH",
+                    help="write Chrome/Perfetto trace-event JSON "
+                         "(load at ui.perfetto.dev)")
+    pv.add_argument("--telemetry", metavar="PATH",
+                    help="write the decoded events as schema-versioned "
+                         "JSONL (tools/analyze.py --telemetry)")
+    # backend="jax" so main()'s x64 hook below arms the Go-exact stream
+    pv.set_defaults(fn=_cmd_trace, backend="jax")
 
     ps = sub.add_parser("storm", help="batched scale run")
     ps.add_argument("--graph", choices=["ring", "er", "sf"], default="sf")
@@ -490,6 +576,16 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)  # resume-test hook: exit 17
     #                                          right after that chunk's
     #                                          checkpoint lands
+    ps.add_argument("--trace", action="store_true",
+                    help="arm the device flight recorder (per-lane event "
+                         "ring, utils/tracing.py); adds trace_events/"
+                         "trace_dropped to the JSON row")
+    ps.add_argument("--trace-capacity", type=int, default=0, metavar="K",
+                    help="ring slots per lane (0 = JaxTrace default when "
+                         "--trace is set); implies --trace when > 0")
+    ps.add_argument("--telemetry", metavar="PATH",
+                    help="append the run's JSON row as schema-versioned "
+                         "JSONL telemetry (tools/analyze.py --telemetry)")
     ps.set_defaults(fn=_cmd_storm)
 
     pq = sub.add_parser("stream", help="continuous-lane streaming run "
@@ -540,6 +636,15 @@ def main(argv=None) -> int:
     pq.add_argument("--kill-after-saves", type=int, default=None,
                     help=argparse.SUPPRESS)  # resume-test hook: exit 17
     #                                          after that many checkpoints
+    pq.add_argument("--trace", action="store_true",
+                    help="arm the device flight recorder (lane-admit/"
+                         "harvest land in the per-lane rings)")
+    pq.add_argument("--trace-capacity", type=int, default=0, metavar="K",
+                    help="ring slots per lane (0 = JaxTrace default when "
+                         "--trace is set); implies --trace when > 0")
+    pq.add_argument("--telemetry", metavar="PATH",
+                    help="append a stream_run row plus one stream_job row "
+                         "per harvested job as schema-versioned JSONL")
     pq.set_defaults(fn=_cmd_stream)
 
     pb = sub.add_parser("bench", help="node-ticks/sec benchmark")

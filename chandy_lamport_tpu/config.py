@@ -86,6 +86,15 @@ class SimConfig:
     # utils/metrics.snapshot_lifecycle). Size max_snapshots to
     # run_length / snapshot_every.
     snapshot_every: int = 0
+    # Device flight recorder (utils/tracing.py): capacity K of the
+    # per-lane event trace ring riding on DenseState (three i32 [K]
+    # planes tr_meta/tr_data/tr_tick + counters). 0 (the default) gives
+    # zero-size planes and zero trace ops in the kernels — runners bump
+    # it to JaxTrace.DEFAULT_CAPACITY when tracing is requested with the
+    # knob left at 0. The ring WRAPS on overflow: the oldest events are
+    # overwritten and the loss is surfaced as a dropped-events count
+    # (max(0, tr_count - K)) in summarize()/storm JSON, never silently.
+    trace_capacity: int = 0
 
     def __post_init__(self):
         if self.queue_capacity <= 0 or self.max_snapshots <= 0 or self.max_recorded <= 0:
@@ -112,6 +121,10 @@ class SimConfig:
             raise ValueError(
                 "snapshot_timeout/snapshot_retries/snapshot_every must be "
                 ">= 0 (0 disables the supervisor / daemon)")
+        if self.trace_capacity < 0:
+            raise ValueError(
+                "trace_capacity must be >= 0 (0 disables the flight "
+                "recorder)")
 
     @classmethod
     def for_workload(cls, *, snapshots: int, max_delay: int = MAX_DELAY,

@@ -45,6 +45,39 @@ class DenseBackendError(RuntimeError):
     (the jit-compatible stand-in for the reference's log.Fatal calls)."""
 
 
+class DenseTraceView:
+    """Host-side view of the device flight-recorder ring — the dense
+    backend's answer to the parity backend's EpochTrace surface: ``events``
+    decodes the ring (utils/tracing.decode_trace), ``pretty()`` renders the
+    reference Logger's epoch format (so dense and parity traces diff
+    directly), ``perfetto()`` exports Chrome/Perfetto trace-event JSON and
+    ``counts()`` returns (recorded, dropped)."""
+
+    def __init__(self, sim: "DenseSim"):
+        self._sim = sim
+
+    @property
+    def events(self):
+        from chandy_lamport_tpu.utils.tracing import decode_trace
+
+        return decode_trace(self._sim._host())
+
+    def counts(self):
+        from chandy_lamport_tpu.utils.tracing import trace_counts
+
+        return trace_counts(self._sim._host())
+
+    def pretty(self) -> str:
+        from chandy_lamport_tpu.utils.tracing import trace_pretty
+
+        return trace_pretty(self.events, self._sim.topo)
+
+    def perfetto(self) -> dict:
+        from chandy_lamport_tpu.utils.tracing import trace_to_perfetto
+
+        return trace_to_perfetto(self.events, self._sim.topo)
+
+
 class DenseSim:
     """Single-instance dense simulator on the JAX backend."""
 
@@ -52,7 +85,7 @@ class DenseSim:
                  delay_model: Union[DelayModel, JaxDelay],
                  config: Optional[SimConfig] = None,
                  exact_impl: str = "cascade", megatick: int = 8,
-                 queue_engine: str = "auto", faults=None):
+                 queue_engine: str = "auto", faults=None, trace=None):
         """``megatick``: K-tick fusion depth for ``tick N`` events and the
         drain loop (ops/tick.TickKernel docstring); semantics-preserving,
         1 restores the reference-literal one-iteration-per-tick loops (the
@@ -62,7 +95,10 @@ class DenseSim:
         (default, backend-resolved); bit-identical results.
         ``faults``: models/faults.JaxFaults or None — arm the deterministic
         fault adversary (TickKernel docstring); None compiles the hooks
-        away entirely."""
+        away entirely.
+        ``trace``: utils/tracing.JaxTrace or None — arm the device flight
+        recorder; ``self.trace`` then exposes the decoded timeline
+        (DenseTraceView). None compiles every trace op away."""
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -72,9 +108,20 @@ class DenseSim:
         if self.delay.max_delay != self.config.max_delay:
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
+        if trace is not None and self.config.trace_capacity == 0:
+            from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+            self.config = dataclasses.replace(
+                self.config,
+                trace_capacity=getattr(trace, "capacity", 0)
+                or JaxTrace.DEFAULT_CAPACITY)
         self.kernel = TickKernel(self.topo, self.config, self.delay,
                                  exact_impl=exact_impl, megatick=megatick,
-                                 queue_engine=queue_engine, faults=faults)
+                                 queue_engine=queue_engine, faults=faults,
+                                 trace=trace)
+        # same surface as ParitySim: ``sim.trace`` is the timeline view
+        # when armed, None otherwise
+        self.trace = DenseTraceView(self) if self.kernel._trace_on else None
         self.state: DenseState = init_state(
             self.topo, self.config, self.delay.init_state(),
             fault_key=int(faults.init_state()) if faults is not None else 0)

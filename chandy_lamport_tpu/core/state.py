@@ -348,6 +348,18 @@ class DenseState(NamedTuple):
     #                    stages (end=drain, end+1=flush, end+2=done)
     admit_tick: Any    # i32 [] stream step at which the job was admitted
     #                    (occupancy/latency accounting; 0 for lane 0 jobs)
+    # device flight-recorder ring (utils/tracing.py; checkpoint format v7
+    # leaves). K = SimConfig.trace_capacity slots per lane of packed event
+    # words written by .at[] scatters inside the tick kernels; K = 0 (the
+    # default) makes these zero-size and the kernels contain zero trace
+    # ops (the faults=None bit-identity contract).
+    tr_meta: Any       # i32 [K] actor << 5 | kind (tracing.pack_event)
+    tr_data: Any       # i32 [K] event payload (amount / sid / class / job)
+    tr_tick: Any       # i32 [K] s.time at record
+    tr_count: Any      # i32 [] events EVER recorded (write pos = count % K;
+    #                    dropped-to-wrap = max(0, count - K))
+    tr_on: Any         # i32 [] runtime arm flag (1 = record; armed-idle
+    #                    profiling and pre-roll muting set 0)
     error: Any         # i32 [] sticky bitmask
 
 
@@ -398,6 +410,11 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any,
         job_id=np.int32(-1),
         prog_cursor=np.int32(0),
         admit_tick=np.int32(0),
+        tr_meta=np.zeros(cfg.trace_capacity, i32),
+        tr_data=np.zeros(cfg.trace_capacity, i32),
+        tr_tick=np.zeros(cfg.trace_capacity, i32),
+        tr_count=np.int32(0),
+        tr_on=np.int32(1),
         error=np.int32(0),
     )
 

@@ -63,6 +63,20 @@ from chandy_lamport_tpu.core.state import (
     pack_meta,
 )
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
+from chandy_lamport_tpu.utils.tracing import (
+    EV_FAULT,
+    EV_MRECV,
+    EV_MSEND,
+    EV_RECV,
+    EV_SEND,
+    EV_SNAP_END,
+    EV_SNAP_START,
+    EV_SUP_ABORT,
+    EV_SUP_FAIL,
+    EV_SUP_RETRY,
+    trace_append_many,
+    trace_append_one,
+)
 
 _i32 = jnp.int32
 
@@ -214,7 +228,7 @@ class TickKernel:
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
                  marker_mode: str = "ring", exact_impl: str = "cascade",
                  megatick: int = 8, queue_engine: str = "auto",
-                 faults=None, quarantine: bool = False):
+                 faults=None, quarantine: bool = False, trace=None):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -286,7 +300,18 @@ class TickKernel:
         as stale); exhausted retries raise ERR_SNAPSHOT_TIMEOUT. Both
         knobs at 0 (default) trace zero supervisor ops, and an
         armed-but-idle supervisor is bit-identical to the unsupervised
-        kernel (tests/test_snapshot_supervisor.py)."""
+        kernel (tests/test_snapshot_supervisor.py).
+
+        trace (utils/tracing.JaxTrace or None) arms the device flight
+        recorder: every protocol event the reference Logger records —
+        plus supervisor and fault events — is appended to the per-lane
+        ring riding on DenseState (tr_* leaves) by cheap ``.at[]``
+        scatters at the handler sites. None (default, the faults=None
+        contract again) compiles the recorder away entirely: the kernels
+        contain zero trace ops and lower bit-identically to an
+        uninstrumented build (tests/test_trace.py asserts this on the
+        goldens). cfg.trace_capacity must be > 0 for an armed recorder
+        to have anywhere to write (runners bump it before building)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
         if (faults is not None and marker_mode == "ring"
@@ -328,6 +353,9 @@ class TickKernel:
         self.queue_engine = queue_engine
         self.faults = faults
         self.quarantine = bool(quarantine)
+        # zero trace ops unless armed AND the ring has capacity — every
+        # recorder site below is guarded on this static flag
+        self._trace_on = trace is not None and cfg.trace_capacity > 0
         self.topo = topo
         self.cfg = cfg
         self.delay = delay
@@ -531,8 +559,12 @@ class TickKernel:
         counts = s.fault_counts.at[FC_MDROP].add(
             jnp.sum(dropped, dtype=_i32)).at[FC_MDUP].add(
             jnp.sum(duped, dtype=_i32))
-        return (s._replace(fault_counts=counts),
-                mk_pend & ~dropped, duped, mdup_rt)
+        s = s._replace(fault_counts=counts)
+        if self._trace_on:
+            s = trace_append_many(s, dropped, EV_FAULT, self._rows_e,
+                                  FC_MDROP)
+            s = trace_append_many(s, duped, EV_FAULT, self._rows_e, FC_MDUP)
+        return s, mk_pend & ~dropped, duped, mdup_rt
 
     def _fault_gate_elig(self, s: DenseState, elig, jit_e, mjit_e=None,
                          marker_front=None):
@@ -543,9 +575,11 @@ class TickKernel:
         destination receives nothing — its in-flight messages WAIT
         (channels stay lossless; recovery is the point, not message
         loss). Returns (state with jitter events counted, elig)."""
-        blocked = elig & jit_e
+        jblocked = elig & jit_e
+        blocked = jblocked
         counts = s.fault_counts.at[FC_JITTER].add(
-            jnp.sum(blocked, dtype=_i32))
+            jnp.sum(jblocked, dtype=_i32))
+        mblocked = None
         if mjit_e is not None:
             mblocked = elig & marker_front & mjit_e
             counts = counts.at[FC_MJITTER].add(jnp.sum(mblocked, dtype=_i32))
@@ -553,6 +587,12 @@ class TickKernel:
         down_n = self.faults.down_nodes(s.fault_key, s.time, self.topo.n)
         dead = elig & self._spread_dst(down_n)
         s = s._replace(fault_counts=counts)
+        if self._trace_on:
+            s = trace_append_many(s, jblocked, EV_FAULT, self._rows_e,
+                                  FC_JITTER)
+            if mblocked is not None:
+                s = trace_append_many(s, mblocked, EV_FAULT, self._rows_e,
+                                      FC_MJITTER)
         return s, elig & ~blocked & ~dead
 
     def _fault_split_tokens(self, s: DenseState, tok_e, amt_src, drop_e,
@@ -569,9 +609,12 @@ class TickKernel:
         counts = s.fault_counts.at[FC_DROP].add(
             jnp.sum(dropped, dtype=_i32)).at[FC_DUP].add(
             jnp.sum(duped, dtype=_i32))
-        return (s._replace(fault_skew=s.fault_skew + skew,
-                           fault_counts=counts),
-                tok_e & ~dropped, duped)
+        s = s._replace(fault_skew=s.fault_skew + skew, fault_counts=counts)
+        if self._trace_on:
+            s = trace_append_many(s, dropped, EV_FAULT, self._rows_e,
+                                  FC_DROP)
+            s = trace_append_many(s, duped, EV_FAULT, self._rows_e, FC_DUP)
+        return s, tok_e & ~dropped, duped
 
     def _fault_restart(self, s: DenseState) -> DenseState:
         """Crash-window restarts at tick start (s.time already incremented).
@@ -587,6 +630,10 @@ class TickKernel:
         n = self.topo.n
         rs_n = f.restarted(s.fault_key, s.time, n)
         counts = s.fault_counts.at[FC_CRASH].add(jnp.sum(rs_n, dtype=_i32))
+        if self._trace_on:
+            # the only FAULT event whose actor is a NODE, not an edge
+            s = trace_append_many(s, rs_n, EV_FAULT,
+                                  jnp.arange(n, dtype=_i32), FC_CRASH)
         if f.crash_mode != "lossy":
             return s._replace(fault_counts=counts)
         S = self.cfg.max_snapshots
@@ -683,6 +730,15 @@ class TickKernel:
             error=s.error | jnp.where(jnp.any(failed),
                                       ERR_SNAPSHOT_TIMEOUT, 0).astype(_i32),
         )
+        if self._trace_on:
+            # the supervisor's decisions in decision order: every timed-out
+            # attempt aborts, then either retries (the re-initiation's
+            # marker-sends follow from _sup_reinitiate_*) or fails for good
+            init_n = jnp.clip(s.snap_initiator, 0, self.topo.n - 1)
+            slot = jnp.arange(self.cfg.max_snapshots, dtype=_i32)
+            s = trace_append_many(s, timed_out, EV_SUP_ABORT, init_n, slot)
+            s = trace_append_many(s, can_retry, EV_SUP_RETRY, init_n, slot)
+            s = trace_append_many(s, failed, EV_SUP_FAIL, init_n, slot)
         return s, can_retry
 
     def _sup_reinitiate_ring(self, s: DenseState, retry) -> DenseState:
@@ -857,6 +913,10 @@ class TickKernel:
         where the plane index is the id and aborts clear in place — no
         epoch storage needed). One delay draw either way, so the sampler
         stream is mode-invariant."""
+        if self._trace_on:
+            # the trace carries the RAW sid; the epoch-packed word is a
+            # wire encoding (state.pack_marker_data), not an event fact
+            s = trace_append_one(s, True, EV_MSEND, e, sid)
         if self.marker_mode == "ring":
             return self._push(s, e, True, self._marker_payload(s, sid))
         rtime, dstate = self.delay.draw(s.delay_state, s.time)
@@ -943,8 +1003,15 @@ class TickKernel:
         active = jnp.zeros(self.topo.e, jnp.bool_).at[tgt].set(
             True, mode="drop")
         rt_e = jnp.zeros(self.topo.e, _i32).at[tgt].set(rts_k, mode="drop")
-        return self._append_rows(s, active, rt_e, True,
-                                 self._marker_payload(s, sid))
+        s = self._append_rows(s, active, rt_e, True,
+                              self._marker_payload(s, sid))
+        if self._trace_on:
+            # active edges are the broadcaster's outbound row in edge
+            # (= dest) order — the ranked append preserves it, matching
+            # the reference's sorted-dest send loop (node.go:98)
+            s = trace_append_many(s, active, EV_MSEND, self._rows_e,
+                                  jnp.asarray(sid, _i32))
+        return s
 
     def _finalize_check(self, s: DenseState, sid, node) -> DenseState:
         """finalizeSnapshot + NotifyCompletedSnapshot when no links remain
@@ -952,6 +1019,8 @@ class TickKernel:
         decode-time gather — the per-edge log is already in arrival order."""
         fire = (s.has_local[sid, node] & (s.rem[sid, node] == 0)
                 & ~s.done_local[sid, node])
+        if self._trace_on:
+            s = trace_append_one(s, fire, EV_SNAP_END, node, sid)
         return s._replace(
             done_local=s.done_local.at[sid, node].set(
                 s.done_local[sid, node] | fire),
@@ -967,6 +1036,10 @@ class TickKernel:
         _create_local; the repeat branch needs none (edge e delivered this
         marker, so its own count has no pending append this tick)."""
         dst = self._edge_dst[e]
+        if self._trace_on:
+            # receipt recorded before handling, like the reference
+            # (node.go:141 logs before dispatch)
+            s = trace_append_one(s, True, EV_MRECV, e, sid)
 
         def first(s):
             s = self._create_local(s, sid, dst, e, cnt_extra=cnt_extra)
@@ -996,6 +1069,8 @@ class TickKernel:
         snapshot slot is recording this edge, append the amount once to the
         edge's shared arrival log — every recording slot's window covers
         it (DenseState "Recording as windows")."""
+        if self._trace_on:
+            s = trace_append_one(s, True, EV_RECV, e, amount)
         L = self.cfg.max_recorded
         dst = self._edge_dst[e]
         rec = jnp.any(s.recording[:, e])
@@ -1191,6 +1266,12 @@ class TickKernel:
             r = jnp.where(found, self._edge_src[e], _i32(self.topo.n))
             tmask = tok & (self._edge_src < r)
             s = credit(s, tmask)
+            if self._trace_on:
+                # the chunk's RECVs in ascending edge (= ascending source)
+                # order, before the marker that bounds it — exactly the
+                # reference fold's interleaving
+                s = trace_append_many(s, tmask, EV_RECV, self._rows_e,
+                                      amt_e)
             app = app | (tmask & jnp.any(s.recording, axis=-2))
             s = lax.cond(found,
                          lambda s: self._handle_marker(
@@ -1201,6 +1282,8 @@ class TickKernel:
         s, _, tok_pend, app = lax.while_loop(
             cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend)))
         s = credit(s, tok_pend)
+        if self._trace_on:
+            s = trace_append_many(s, tok_pend, EV_RECV, self._rows_e, amt_e)
         app = app | (tok_pend & jnp.any(s.recording, axis=-2))
         log, cnt, err = log_append_masked(
             s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
@@ -1340,6 +1423,13 @@ class TickKernel:
             tmask = tok_rem & (rank_e < jnp.take(wrank_n, self._edge_dst,
                                                  axis=-1))
             s = self._credit(s, tmask, amt_e)
+            if self._trace_on:
+                # wave-order events (per-destination interleaving is
+                # reassociated vs the fold — TickKernel docstring; the
+                # per-tick event SET is identical)
+                s = trace_append_many(s, tmask, EV_RECV, self._rows_e,
+                                      amt_e)
+                s = trace_append_many(s, wm, EV_MRECV, self._rows_e, sid_e)
             app = app | (tmask & jnp.any(s.recording, axis=-2))
             tok_rem = tok_rem & ~tmask
             # repeat markers: close their own channel's window (node.go:
@@ -1395,9 +1485,18 @@ class TickKernel:
                      + self._edge_ord_in_src)
             rt_g = self.delay.block_receive_times(dstate0, time, off_g)
             s = self._append_rows(s, push_g, rt_g, True, sid_g)
+            if self._trace_on:
+                s = trace_append_many(s, push_g, EV_MSEND, self._rows_e,
+                                      sid_g)
             # finalize after every receipt (R8, node.go:165-170)
             wm_sn = (sid_rows == wsid_n[None, :]) & wdst[None, :]  # [S, N]
             fire = wm_sn & s.has_local & (s.rem == 0) & ~s.done_local
+            if self._trace_on:
+                nn = jnp.arange(self.topo.n, dtype=_i32)
+                s = trace_append_many(
+                    s, fire, EV_SNAP_END,
+                    jnp.broadcast_to(nn[None, :], fire.shape),
+                    jnp.broadcast_to(sid_rows, fire.shape))
             s = s._replace(
                 done_local=s.done_local | fire,
                 completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32))
@@ -1407,6 +1506,8 @@ class TickKernel:
             cond, body, (s, mk_pend, tok_pend, jnp.zeros_like(tok_pend),
                          jnp.int32(0)))
         s = self._credit(s, tok_rem, amt_e)
+        if self._trace_on:
+            s = trace_append_many(s, tok_rem, EV_RECV, self._rows_e, amt_e)
         app = app | (tok_rem & jnp.any(s.recording, axis=-2))
         log, cnt, err = log_append_masked(
             s.log_amt, s.rec_cnt, s.min_prot, app, amt_e,
@@ -1517,6 +1618,10 @@ class TickKernel:
         s = s._replace(
             tokens=s.tokens + credit,
             error=s.error | jnp.where(toobig, ERR_VALUE_OVERFLOW, 0).astype(_i32))
+        if self._trace_on:
+            # 'all tokens before all markers' is this scheduler's real
+            # intra-tick order, so the trace records it as such
+            s = trace_append_many(s, tok_e, EV_RECV, self._rows_e, amt_e)
         # shared-log append (DenseState "Recording as windows"): one [L, E]
         # one-hot write instead of the former dense [S, M, E] rewrite (the
         # top line of the device profile at 5.2 ms/tick, 8x this write)
@@ -1550,8 +1655,18 @@ class TickKernel:
             s = s._replace(fault_counts=s.fault_counts.at[FC_MDROP].add(
                 jnp.sum(mk_drop_e, dtype=_i32)).at[FC_MDUP].add(
                 jnp.sum(mk_dup_e, dtype=_i32)))
+            if self._trace_on:
+                s = trace_append_many(s, mk_drop_e, EV_FAULT, self._rows_e,
+                                      FC_MDROP)
+                s = trace_append_many(s, mk_dup_e, EV_FAULT, self._rows_e,
+                                      FC_MDUP)
             mk_e = mk_e & ~mk_drop_e
         mk_se = m_is_front & jnp.expand_dims(mk_e, -2)             # [S, E]
+        if self._trace_on:
+            # the consumed front's plane index IS the snapshot id
+            sid_e = jnp.sum(jnp.where(
+                mk_se, jnp.arange(S, dtype=_i32)[:, None], 0), axis=-2)
+            s = trace_append_many(s, mk_e, EV_MRECV, self._rows_e, sid_e)
         arrivals = self._sum_by_dst(mk_se, amounts=False)          # [S, N]
         had = s.has_local                                          # [S, N]
         created = (arrivals > 0) & ~had
@@ -1604,6 +1719,13 @@ class TickKernel:
 
         # ---- finalize (node.go:165-170)
         fire = has_local & (rem == 0) & ~s.done_local
+        if self._trace_on:
+            s = trace_append_many(
+                s, fire, EV_SNAP_END,
+                jnp.broadcast_to(jnp.arange(N, dtype=_i32)[None, :],
+                                 fire.shape),
+                jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                 fire.shape))
         return self._stamp_done(s._replace(
             done_local=s.done_local | fire,
             completed=s.completed + jnp.sum(fire, axis=-1, dtype=_i32),
@@ -1727,6 +1849,8 @@ class TickKernel:
     def _inject_send(self, s: DenseState, e, amount) -> DenseState:
         """PassTokenEvent -> SendTokens (node.go:112-131): debit at send time,
         one delay draw, enqueue."""
+        if self._trace_on:
+            s = trace_append_one(s, True, EV_SEND, e, amount)
         src = self._edge_src[e]
         err = s.error | jnp.where(
             s.tokens[src] < amount, ERR_TOKEN_UNDERFLOW, 0).astype(_i32)
@@ -1746,6 +1870,8 @@ class TickKernel:
         s = s._replace(next_sid=s.next_sid + 1,
                        started=s.started.at[sid].set(True),
                        error=err)
+        if self._trace_on:
+            s = trace_append_one(s, True, EV_SNAP_START, node, sid)
         if self._sup:
             # remember the initiator (the supervisor's re-initiation
             # target) and arm the first attempt's deadline
@@ -1783,6 +1909,8 @@ class TickKernel:
         err = s.error | jnp.where(jnp.any(tokens < 0), ERR_TOKEN_UNDERFLOW, 0
                                   ).astype(_i32)
         s = s._replace(tokens=tokens, error=err)
+        if self._trace_on:
+            s = trace_append_many(s, active, EV_SEND, self._rows_e, amounts)
         return self._bulk_push(s, active, False, amounts)
 
     def _push_markers_split(self, s: DenseState, push_se) -> DenseState:
@@ -1802,13 +1930,20 @@ class TickKernel:
         k_e = jnp.sum(push_se, axis=-2, dtype=_i32)                  # [E]
         key_se = (jnp.expand_dims(s.tok_pushed * self._keymult
                                   + s.mk_cnt, -2) + off_se)
-        return s._replace(
+        s = s._replace(
             m_pending=s.m_pending | push_se,
             m_rtime=jnp.where(push_se, jnp.asarray(rts_se, _i32), s.m_rtime),
             m_key=jnp.where(push_se, key_se, s.m_key),
             mk_cnt=s.mk_cnt + k_e,
             delay_state=dstate,
         )
+        if self._trace_on:
+            s = trace_append_many(
+                s, push_se, EV_MSEND,
+                jnp.broadcast_to(self._rows_e[None, :], push_se.shape),
+                jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                 push_se.shape))
+        return s
 
     def _create_and_broadcast(self, s: DenseState, created) -> DenseState:
         """Dense CreateLocalSnapshot + marker broadcast for every True
@@ -1854,6 +1989,10 @@ class TickKernel:
                 s = s._replace(snap_deadline=jnp.where(
                     any_c, s.time + self.cfg.snapshot_timeout,
                     s.snap_deadline))
+        if self._trace_on:
+            s = trace_append_many(s, init_mask, EV_SNAP_START,
+                                  jnp.arange(self.topo.n, dtype=_i32),
+                                  sid_n)
         return self._create_and_broadcast(s, created)
 
     # ---- drain (test_common.go:124-137) ---------------------------------
@@ -1968,9 +2107,15 @@ def reset_lanes(state: DenseState, mask, topo: DenseTopology,
     from chandy_lamport_tpu.core.state import init_state
 
     fresh = init_state(topo, cfg, None)._replace(delay_state=())
+    # the flight-recorder ring is a LANE artifact, not a job artifact: it
+    # spans job admissions (lane-harvest/lane-admit events are exactly the
+    # boundaries), so a recycled slot keeps its event history
     keep = {"delay_state": state.delay_state, "fault_key": state.fault_key,
             "job_id": state.job_id, "prog_cursor": state.prog_cursor,
-            "admit_tick": state.admit_tick}
+            "admit_tick": state.admit_tick,
+            "tr_meta": state.tr_meta, "tr_data": state.tr_data,
+            "tr_tick": state.tr_tick, "tr_count": state.tr_count,
+            "tr_on": state.tr_on}
     flat = state._replace(delay_state=())
 
     def mix(old, tpl):

@@ -46,6 +46,13 @@ from chandy_lamport_tpu.ops.tick import (
     harvest_lane_summaries,
     reset_lanes,
 )
+from chandy_lamport_tpu.utils.tracing import (
+    EV_LANE_ADMIT,
+    EV_LANE_HARVEST,
+    JaxTrace,
+    trace_append_lanes,
+    trace_counts,
+)
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 from chandy_lamport_tpu.utils.layouts import (
     HAVE_LAYOUTS,
@@ -210,7 +217,7 @@ class BatchedRunner:
                  check_every: int = 0, exact_impl: str = "cascade",
                  auto_layouts: bool = False, megatick: int = 1,
                  queue_engine: str = "auto", faults=None,
-                 quarantine: bool = False):
+                 quarantine: bool = False, trace=None):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -276,7 +283,17 @@ class BatchedRunner:
         treat ``error != 0`` like the quiescence exit, so one poisoned
         lane stops ticking (its time freezes at the poisoning tick)
         instead of corrupting aggregate metrics; healthy lanes are
-        bit-unaffected. summarize() reports the decode."""
+        bit-unaffected. summarize() reports the decode.
+
+        trace: utils/tracing.JaxTrace — arm the per-lane device flight
+        recorder: the tick kernels append packed event words (send/recv,
+        marker traffic, snapshot lifecycle, supervisor actions, fault
+        firings) into the DenseState trace ring, and the streaming engine
+        stamps lane admissions/harvests. When the config leaves
+        ``trace_capacity`` at 0, it is bumped to the trace's capacity here
+        so the ring planes exist. None (default) compiles every trace op
+        away — the kernels are bit-identical to a build without the
+        feature (the faults=None contract)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.delay = delay
@@ -288,6 +305,16 @@ class BatchedRunner:
 
             self.config = dataclasses.replace(
                 self.config, max_delay=self.delay.max_delay)
+        self.trace = trace
+        if trace is not None and self.config.trace_capacity == 0:
+            import dataclasses
+
+            # the ring planes are sized by the config; an armed trace with
+            # the knob left at its 0 default gets the trace's capacity
+            self.config = dataclasses.replace(
+                self.config,
+                trace_capacity=getattr(trace, "capacity", 0)
+                or JaxTrace.DEFAULT_CAPACITY)
         if scheduler not in ("exact", "sync"):
             raise ValueError(f"unknown scheduler {scheduler!r}")
         # sync uses the split marker representation (ring content untouched
@@ -297,10 +324,11 @@ class BatchedRunner:
             marker_mode="split" if scheduler == "sync" else "ring",
             exact_impl=exact_impl, megatick=megatick,
             queue_engine=queue_engine, faults=faults,
-            quarantine=quarantine)
+            quarantine=quarantine, trace=trace)
         self.queue_engine = self.kernel.queue_engine
         self.faults = faults
         self.quarantine = bool(quarantine)
+        self._trace_on = self.kernel._trace_on
         if scheduler == "exact":
             self._tick_fn = self.kernel._exact_tick
             self._drain_fn = self.kernel._drain_and_flush
@@ -439,7 +467,11 @@ class BatchedRunner:
                                            jnp.iinfo(jnp.int32).max),
                     snap_initiator=jnp.full_like(st.snap_initiator, -1),
                     snap_done_time=jnp.full_like(st.snap_done_time, -1),
-                    job_id=jnp.full_like(st.job_id, -1))
+                    job_id=jnp.full_like(st.job_id, -1),
+                    # the flight recorder is born armed (state.init_state);
+                    # a zeroed tr_on would silently disarm device-built
+                    # states
+                    tr_on=jnp.ones_like(st.tr_on))
                 if self.faults is not None:
                     st = st._replace(
                         fault_key=self.faults.init_batch_state(self.batch))
@@ -926,6 +958,11 @@ class BatchedRunner:
             new_jid = stream.next_job + arank
             new_jidc = jnp.clip(new_jid, 0, jmax)
             reset = fin | admit
+            if self._trace_on:
+                # stamp the retiring job ids BEFORE the reset; the trace
+                # ring is a lane artifact (reset_lanes carries it across
+                # job boundaries), so the harvest event survives the wipe
+                state = trace_append_lanes(state, fin, EV_LANE_HARVEST, jid)
             state = reset_lanes(state, reset, self.topo, self.config)
 
             def pick(p, old):
@@ -948,6 +985,9 @@ class BatchedRunner:
                                                 state.prog_cursor)),
                 admit_tick=jnp.where(admit, stream.steps,
                                      jnp.where(reset, 0, state.admit_tick)))
+            if self._trace_on:
+                state = trace_append_lanes(state, admit, EV_LANE_ADMIT,
+                                           new_jid)
             stream = stream._replace(
                 next_job=stream.next_job + jnp.sum(admit, dtype=jnp.int32),
                 refills=stream.refills + jnp.sum(admit & fin,
@@ -994,7 +1034,7 @@ class BatchedRunner:
 
         Checkpointing: with ``checkpoint`` + ``checkpoint_every`` k, every
         k-th step atomically saves the combined ``(state, stream)`` pytree
-        (utils/checkpoint.save_state — format v6). Resume by loading with
+        (utils/checkpoint.save_state — format v7). Resume by loading with
         ``like=(runner.init_batch(), runner.init_stream(pool))`` and
         passing ``state=``/``stream=`` back in; the continuation is
         bit-exact because admission order, per-job streams and the results
@@ -1091,6 +1131,7 @@ class BatchedRunner:
 
         bits = int(or_reduce(state.error))
         fc = jnp.sum(state.fault_counts, axis=0)
+        tr_rec, tr_drop = trace_counts(state)
         return {
             "instances": int(state.time.shape[0]),
             "total_ticks": int(jnp.sum(state.time)),
@@ -1119,6 +1160,11 @@ class BatchedRunner:
                              "marker_dups": int(fc[5]),
                              "marker_jitters": int(fc[6])},
             "fault_skew": int(jnp.sum(state.fault_skew)),
+            # flight-recorder books (utils/tracing.trace_counts): events
+            # resident in the rings + events overwritten by wraparound —
+            # the overflow is surfaced here, never silent
+            "trace_events": int(tr_rec),
+            "trace_dropped": int(tr_drop),
             # supervisor lifecycle (utils/metrics.snapshot_lifecycle):
             # initiated / completed / retried / failed / aborted /
             # stale_markers + recovery-line age, summed over lanes

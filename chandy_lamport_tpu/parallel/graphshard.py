@@ -63,6 +63,15 @@ from chandy_lamport_tpu.ops.tick import (
     resolve_queue_engine,
     window_update,
 )
+from chandy_lamport_tpu.utils.tracing import (
+    EV_SNAP_END,
+    EV_SNAP_START,
+    EV_SUP_ABORT,
+    EV_SUP_FAIL,
+    EV_SUP_RETRY,
+    JaxTrace,
+    trace_append_many,
+)
 from chandy_lamport_tpu.utils.fixtures import TopologySpec
 
 _i32 = jnp.int32
@@ -156,6 +165,18 @@ class ShardedState(NamedTuple):
     snap_initiator: Any  # i32 [S] (replicated; -1 = unset)
     snap_failed: Any     # bool [S] (replicated)
     snap_done_time: Any  # i32 [S] (replicated; -1 until completed)
+    # flight-recorder ring (utils/tracing; core/state.DenseState tr_*) —
+    # REPLICATED: every shard appends the same replicated-event stream
+    # (snapshot lifecycle, supervisor actions) with replicated operands, so
+    # the rings stay bit-identical across shards. Per-node/per-edge events
+    # (sends, marker traffic) are shard-LOCAL facts; appending them would
+    # diverge the replicated ring, so the sharded recorder captures the
+    # global protocol timeline only.
+    tr_meta: Any     # i32 [K] (replicated)
+    tr_data: Any     # i32 [K] (replicated)
+    tr_tick: Any     # i32 [K] (replicated)
+    tr_count: Any    # i32 [] (replicated)
+    tr_on: Any       # i32 [] (replicated)
     delay_key: Any   # u32 [P, 2] per-shard counter-based key
     error: Any       # i32 [] (replicated)
 
@@ -216,7 +237,7 @@ class GraphShardedRunner:
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
                  max_delay: int = 5, fixed_delay: Optional[int] = None,
                  check_every: int = 0, queue_engine: str = "auto",
-                 quarantine: bool = False):
+                 quarantine: bool = False, trace=None):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -241,7 +262,13 @@ class GraphShardedRunner:
         SPMD discipline as the conservation-check cond); in the batched
         data x graph mode the gate applies per lane under vmap. Fault
         INJECTION stays a dense/batched-path feature — ShardedState
-        carries no adversary leaves."""
+        carries no adversary leaves.
+
+        trace: utils/tracing.JaxTrace — arm the replicated flight
+        recorder: snapshot lifecycle (start/end) and supervisor actions
+        (abort/retry/fail) append to the replicated trace ring (the
+        ShardedState tr_* docstring explains why per-node/per-edge events
+        stay out). None (default) compiles the trace ops away."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.mesh = mesh
@@ -263,6 +290,14 @@ class GraphShardedRunner:
         if self.config.max_delay != self.max_delay:
             self.config = dataclasses.replace(self.config,
                                               max_delay=self.max_delay)
+        self.trace = trace
+        if trace is not None and self.config.trace_capacity == 0:
+            self.config = dataclasses.replace(
+                self.config,
+                trace_capacity=getattr(trace, "capacity", 0)
+                or JaxTrace.DEFAULT_CAPACITY)
+        self._trace_on = (trace is not None
+                          and self.config.trace_capacity > 0)
         # shared numeric-exactness gate + recording helpers with TickKernel
         from chandy_lamport_tpu.ops.tick import count_dtype
 
@@ -305,6 +340,8 @@ class GraphShardedRunner:
             snap_epoch=spec_rep, snap_deadline=spec_rep,
             snap_retries=spec_rep, snap_initiator=spec_rep,
             snap_failed=spec_rep, snap_done_time=spec_rep,
+            tr_meta=spec_rep, tr_data=spec_rep, tr_tick=spec_rep,
+            tr_count=spec_rep, tr_on=spec_rep,
             delay_key=spec_sharded, error=spec_rep)
         self._state_specs = state_specs
 
@@ -371,6 +408,11 @@ class GraphShardedRunner:
             snap_initiator=np.full(s, -1, np.int32),
             snap_failed=np.zeros(s, np.bool_),
             snap_done_time=np.full(s, -1, np.int32),
+            tr_meta=np.zeros(cfg.trace_capacity, np.int32),
+            tr_data=np.zeros(cfg.trace_capacity, np.int32),
+            tr_tick=np.zeros(cfg.trace_capacity, np.int32),
+            tr_count=np.int32(0),
+            tr_on=np.int32(1),
             delay_key=keys,
             error=np.int32(0),
         )
@@ -593,6 +635,15 @@ class GraphShardedRunner:
                 s = s._replace(snap_deadline=jnp.where(
                     any_c, s.time + self.config.snapshot_timeout,
                     s.snap_deadline))
+        if self._trace_on:
+            # replicated operands only (created is replicated), so every
+            # shard appends the identical event and the ring stays uniform
+            s = trace_append_many(
+                s, created, EV_SNAP_START,
+                jnp.broadcast_to(jnp.arange(self.topo.n, dtype=_i32)[None, :],
+                                 created.shape),
+                jnp.broadcast_to(jnp.arange(S, dtype=_i32)[:, None],
+                                 created.shape))
         return self._create_and_broadcast(s, st, created)
 
     def _inject_send_local(self, s: ShardedState, st: ShardedTopology,
@@ -683,6 +734,13 @@ class GraphShardedRunner:
             error=s.error | jnp.where(jnp.any(failed),
                                       ERR_SNAPSHOT_TIMEOUT, 0).astype(_i32),
         )
+        if self._trace_on:
+            # replicated masks + initiators: uniform appends across shards
+            init_n = jnp.clip(s.snap_initiator, 0, n - 1)
+            slot = jnp.arange(S, dtype=_i32)
+            s = trace_append_many(s, timed_out, EV_SUP_ABORT, init_n, slot)
+            s = trace_append_many(s, can_retry, EV_SUP_RETRY, init_n, slot)
+            s = trace_append_many(s, failed, EV_SUP_FAIL, init_n, slot)
         created = can_retry[:, None] & (
             jnp.arange(n, dtype=_i32)
             == jnp.clip(s.snap_initiator, 0, n - 1)[:, None])  # [S, N] rep
@@ -781,6 +839,15 @@ class GraphShardedRunner:
         # every shard computes the same value
         newly = (s.started & (completed >= self.topo.n)
                  & (s.snap_done_time < 0))
+        if self._trace_on:
+            # one GLOBAL completion event per snapshot (the per-node fire
+            # mask is shard-local and cannot touch the replicated ring);
+            # actor = the remembered initiator when the supervisor runs,
+            # node 0 otherwise
+            s = trace_append_many(
+                s, newly, EV_SNAP_END,
+                jnp.clip(s.snap_initiator, 0, self.topo.n - 1),
+                jnp.arange(S, dtype=_i32))
         return s._replace(done_local=s.done_local | fire,
                           completed=completed,
                           snap_done_time=jnp.where(newly, s.time,
@@ -1099,6 +1166,13 @@ class GraphShardedRunner:
             snap_failed=np.asarray(h.snap_failed),
             snap_done_time=np.asarray(h.snap_done_time),
             stale_markers=np.int32(0),
+            # the replicated flight-recorder ring carries straight over
+            # (global protocol events only — the ShardedState docstring)
+            tr_meta=np.asarray(h.tr_meta),
+            tr_data=np.asarray(h.tr_data),
+            tr_tick=np.asarray(h.tr_tick),
+            tr_count=np.asarray(h.tr_count),
+            tr_on=np.asarray(h.tr_on),
             # the sharded runner simulates one instance end to end — no job
             # streaming; reassemble with the idle-lane defaults
             job_id=np.int32(-1),

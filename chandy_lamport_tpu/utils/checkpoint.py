@@ -64,7 +64,13 @@ from chandy_lamport_tpu.core.state import DenseState
 #       resumes its admission state bit-exactly; a version-5 checkpoint is
 #       three leaves short and errors here rather than misalign every
 #       leaf after stale_markers
-_FORMAT_VERSION = 6
+#   7 — PR-7 flight-recorder leaves (tr_meta/tr_data/tr_tick/tr_count/
+#       tr_on, core/state.py): the per-lane device trace ring joins the
+#       carry so a kill mid-run resumes with its event history (and its
+#       dropped-events accounting) bit-exact; a version-6 checkpoint is
+#       five leaves short and errors here rather than misalign every
+#       leaf after admit_tick
+_FORMAT_VERSION = 7
 # every layout change so far has been breaking (leaves added or reshaped),
 # so exactly one version is live; kept as a range so a future
 # backward-compatible revision can widen the floor without touching the

@@ -1,15 +1,21 @@
 """Jit-compatible observability: invariants + aggregate counters.
 
 The reference's only observability is the debug event log (logger.go) and the
-test-side token-conservation check (test_common.go:298-328). Structured
-per-event capture is incompatible with jit hot loops (SURVEY.md §5), so the
-array backends expose the TPU-friendly equivalents:
+test-side token-conservation check (test_common.go:298-328). The array
+backends expose two TPU-friendly layers:
 
-  - ``in_flight_tokens`` / ``conservation_delta``: the conservation invariant
-    as pure array reductions, evaluable under jit every K ticks;
-  - ``progress_counters``: queue depths, snapshot lifecycle counts, error
-    bits — cheap reductions whose cross-device lowering is the collective
-    path when the batch axis is sharded.
+  - aggregate counters (this module): ``in_flight_tokens`` /
+    ``conservation_delta`` evaluate the conservation invariant as pure array
+    reductions, runnable under jit every K ticks; ``progress_counters``
+    gives queue depths, snapshot lifecycle counts and error bits — cheap
+    reductions whose cross-device lowering is the collective path when the
+    batch axis is sharded;
+  - per-event capture (utils/tracing.py): the device flight recorder — a
+    fixed-capacity ring of packed event words written by ``.at[]`` scatters
+    inside the jitted tick paths, decoded host-side into the reference
+    Logger's format. Per-event capture at the reference's granularity IS
+    jit-compatible once the log is a bounded dense ring instead of a
+    growing list; what stays host-side is only the decode.
 
 All functions take a DenseState with ANY batching (none, leading axis,
 trailing axis): reductions run over the structural axes only where needed and
@@ -171,12 +177,16 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
     footprint = 8·E·C + (24 + rec·L)·E + 4·N + S·(22 + 10·N + (10+2·win)·E)
+                + 12·K + 8
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16),
     win = itemsize of SimConfig.window_dtype (4 default, 2 for uint16),
     and L = cfg.max_recorded (shared per-edge log slots). The 8·E·C term
     is the two packed int32 ring planes (q_meta = rtime<<1|marker, q_data;
     core/state.py "Packed ring slots" — the former separate bool marker
-    plane is folded into q_meta).
+    plane is folded into q_meta). The 12·K + 8 term is the flight-recorder
+    ring (three i32 planes of K = cfg.trace_capacity slots plus the
+    tr_count / tr_on scalars, utils/tracing.py); the default trace-off
+    configuration pays only the 8 counter bytes (K = 0).
 
     Dominant terms at bench shapes are the [S, E] recording/window/marker
     planes and the per-edge log ``log_amt[L, E]`` — size S and L to the
@@ -204,7 +214,9 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     # stale_markers, completed, and the streaming-engine job identity
     # (job_id/prog_cursor/admit_tick)
     scalars = 4 * 3 + 4 * 10 + s * 4 + 4 * 3
-    return queues + nodes + rec_log + snaps + scalars
+    # flight-recorder ring: tr_meta/tr_data/tr_tick[K] + tr_count/tr_on
+    trace = 12 * cfg.trace_capacity + 8
+    return queues + nodes + rec_log + snaps + scalars + trace
 
 
 def max_batch_estimate(num_nodes: int, num_edges: int, cfg: SimConfig,

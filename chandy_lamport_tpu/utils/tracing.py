@@ -1,20 +1,38 @@
-"""Epoch-bucketed event trace — the parity backend's observability hook.
+"""Event tracing: the parity backend's epoch log AND the device-side
+flight recorder shared by every JAX tick path.
 
-Replicates the reference Logger (logger.go:12-76): events bucketed per time
-step, each capturing the node's token balance at record time (logger.go:74 —
-note sends record the balance *before* the debit, node.go:118-120). Pretty
-printing matches the reference's record strings (common.go:75-122).
+Two capture mechanisms, one event vocabulary:
 
-For the JAX backend, structured per-event capture is incompatible with jit;
-its equivalents are (a) aggregate counters reduced from DenseState
-(utils/metrics.py progress_counters) and (b) ``jax.profiler`` traces via
-``bench --profile`` for kernel-level timing (SURVEY.md §5).
+* ``EpochTrace`` replicates the reference Logger (logger.go:12-76): events
+  bucketed per time step, each capturing the node's token balance at record
+  time (logger.go:74 — note sends record the balance *before* the debit,
+  node.go:118-120). Pretty printing matches the reference's record strings
+  (common.go:75-122). Host-side, parity backend only.
+
+* The DEVICE TRACE RING: a fixed-capacity per-lane ring of packed int32
+  event words written by cheap ``.at[]`` scatters *inside* the jitted tick
+  kernels (ops/tick.py), at the same sites the reference Logger records.
+  Three i32 planes of ``SimConfig.trace_capacity`` slots ride on DenseState
+  (``tr_meta`` = actor << 5 | kind, ``tr_data`` = payload, ``tr_tick``)
+  plus a monotonic total-events counter ``tr_count`` (write position =
+  count % K; dropped = max(0, count - K) — overflow wraps, never silently
+  truncates) and a runtime arm flag ``tr_on``. With ``trace=None`` the
+  kernels contain zero trace ops and lower bit-identically to the
+  uninstrumented build (the ``faults=None`` pattern, models/faults.py).
+
+Host-side, ``decode_trace`` unrolls the ring chronologically;
+``trace_pretty`` renders the reference Logger's exact record strings (the
+golden-parity surface against ``EpochTrace``); ``trace_to_perfetto`` emits
+Chrome/Perfetto trace-event JSON (one track per node, snapshot attempts as
+async spans, faults as instants); ``TelemetryWriter`` streams
+schema-versioned JSONL metrics records for tools/analyze.py.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List
+import json
+from typing import Any, Dict, List, NamedTuple, Optional
 
 from chandy_lamport_tpu.core.spec import Message
 
@@ -68,3 +86,363 @@ class EpochTrace:
                 out.append(f"Time {t}:")
                 out.extend(f"\t{e.node_id}: {e.text}" for e in events)
         return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# device trace ring: event vocabulary + packing
+# ---------------------------------------------------------------------------
+
+# Event kinds (5 bits of the packed meta word). The actor field is an EDGE
+# index for the four message events (an edge names both endpoints), a NODE
+# index for snapshot/supervisor/crash events, and 0 for the lane events
+# (the lane is implicit — each lane owns its own ring).
+EV_SEND = 0          # payload = token amount          actor = edge
+EV_RECV = 1          # payload = token amount          actor = edge
+EV_MSEND = 2         # payload = snapshot id           actor = edge
+EV_MRECV = 3         # payload = snapshot id           actor = edge
+EV_SNAP_START = 4    # payload = snapshot id           actor = node
+EV_SNAP_END = 5      # payload = snapshot id           actor = node
+EV_SUP_ABORT = 6     # payload = snapshot slot         actor = initiator node
+EV_SUP_RETRY = 7     # payload = snapshot slot         actor = initiator node
+EV_SUP_FAIL = 8      # payload = snapshot slot         actor = initiator node
+EV_FAULT = 9         # payload = FC_* class            actor = edge (node for
+#                                                      FC_CRASH)
+EV_LANE_ADMIT = 10   # payload = job id                actor = 0
+EV_LANE_HARVEST = 11  # payload = job id               actor = 0
+
+EVENT_KIND_NAMES = (
+    "send", "recv", "marker-send", "marker-recv", "snapshot-start",
+    "snapshot-end", "supervisor-abort", "supervisor-retry",
+    "supervisor-fail", "fault", "lane-admit", "lane-harvest")
+
+_KIND_BITS = 5          # 12 kinds defined, headroom to 31
+_KIND_MASK = (1 << _KIND_BITS) - 1
+
+
+def pack_event(actor, kind):
+    """meta word = actor << 5 | kind (plain arithmetic so it works on
+    Python ints, numpy and jax arrays alike)."""
+    return actor * (1 << _KIND_BITS) + kind
+
+
+def unpack_event(meta):
+    return meta >> _KIND_BITS, meta & _KIND_MASK
+
+
+class JaxTrace:
+    """Arming object for the device flight recorder (the ``JaxFaults``
+    shape): pass ``trace=JaxTrace()`` to DenseSim / BatchedRunner /
+    GraphShardedRunner to compile the event scatters into the tick
+    kernels. ``capacity`` overrides ``SimConfig.trace_capacity`` when the
+    config leaves it 0 (the runner bumps the config before building
+    state)."""
+
+    DEFAULT_CAPACITY = 4096
+
+    def __init__(self, capacity: int = 0):
+        if capacity < 0:
+            raise ValueError("trace capacity must be >= 0")
+        self.capacity = int(capacity)
+
+    def describe(self) -> Dict[str, Any]:
+        return {"capacity": self.capacity or self.DEFAULT_CAPACITY}
+
+
+# ---------------------------------------------------------------------------
+# jit-side append helpers (operate on the tr_* leaves of any state
+# NamedTuple carrying them; statically the identity when capacity == 0)
+# ---------------------------------------------------------------------------
+
+
+def trace_append_many(s, mask, kind, actor, payload):
+    """Ranked multi-event append: every True row of ``mask`` (any shape —
+    flattened here) appends one event, in flattened row order, at
+    consecutive ring positions. The scatter uses the OOB-drop idiom
+    (ops/tick._append_rows): inactive rows aim past the ring and drop.
+    Within one call the targets are (count + rank) % K for consecutive
+    ranks, injective mod K whenever mask.size <= K — ``unique_indices``
+    is only claimed under that static proof."""
+    k = s.tr_meta.shape[-1]
+    if k == 0:
+        return s
+    import jax.numpy as jnp
+
+    i32 = jnp.int32
+    mask = jnp.reshape(jnp.asarray(mask), (-1,))
+    on = mask & (s.tr_on > 0)
+    oni = on.astype(i32)
+    # dtype pinned: under x64 the numpy-style accumulator promotion would
+    # widen the ring counter to int64 and break while_loop carry typing
+    rank = jnp.cumsum(oni, dtype=i32) - oni
+    tgt = jnp.where(on, (s.tr_count + rank) % k, k)
+    meta = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(pack_event(actor, kind), i32), (-1,)),
+        mask.shape)
+    data = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(payload, i32), (-1,)), mask.shape)
+    unique = mask.size <= k
+    return s._replace(
+        tr_meta=s.tr_meta.at[tgt].set(meta, mode="drop",
+                                      unique_indices=unique),
+        tr_data=s.tr_data.at[tgt].set(data, mode="drop",
+                                      unique_indices=unique),
+        tr_tick=s.tr_tick.at[tgt].set(
+            jnp.broadcast_to(jnp.asarray(s.time, i32), mask.shape),
+            mode="drop", unique_indices=unique),
+        tr_count=s.tr_count + jnp.sum(oni, dtype=i32),
+    )
+
+
+def trace_append_one(s, fire, kind, actor, payload):
+    """Scalar conditional append: one event when ``fire`` is True."""
+    k = s.tr_meta.shape[-1]
+    if k == 0:
+        return s
+    import jax.numpy as jnp
+
+    i32 = jnp.int32
+    on = jnp.asarray(fire) & (s.tr_on > 0)
+    tgt = jnp.where(on, s.tr_count % k, k)
+    return s._replace(
+        tr_meta=s.tr_meta.at[tgt].set(
+            jnp.asarray(pack_event(actor, kind), i32), mode="drop"),
+        tr_data=s.tr_data.at[tgt].set(jnp.asarray(payload, i32),
+                                      mode="drop"),
+        tr_tick=s.tr_tick.at[tgt].set(jnp.asarray(s.time, i32),
+                                      mode="drop"),
+        tr_count=s.tr_count + on.astype(i32),
+    )
+
+
+def trace_append_lanes(s, mask_b, kind, payload_b):
+    """Per-lane conditional append on a BATCHED state ([B] leading axis on
+    every tr_* leaf): lane b appends one event (actor 0 — the lane is the
+    ring) when mask_b[b]. Used by the streaming engine's harvest/admit
+    hooks (parallel/batch._build_stream_step)."""
+    k = s.tr_meta.shape[-1]
+    if k == 0:
+        return s
+    import jax.numpy as jnp
+
+    i32 = jnp.int32
+    on = jnp.asarray(mask_b) & (s.tr_on > 0)
+    rows = jnp.arange(on.shape[0], dtype=i32)
+    tgt = jnp.where(on, s.tr_count % k, k)
+    meta = jnp.broadcast_to(jnp.asarray(pack_event(0, kind), i32), on.shape)
+    return s._replace(
+        tr_meta=s.tr_meta.at[rows, tgt].set(meta, mode="drop",
+                                            unique_indices=True),
+        tr_data=s.tr_data.at[rows, tgt].set(
+            jnp.asarray(payload_b, i32), mode="drop", unique_indices=True),
+        tr_tick=s.tr_tick.at[rows, tgt].set(
+            jnp.asarray(s.time, i32), mode="drop", unique_indices=True),
+        tr_count=s.tr_count + on.astype(i32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side decoding + exporters
+# ---------------------------------------------------------------------------
+
+
+class TraceRecord(NamedTuple):
+    tick: int
+    kind: int
+    actor: int
+    payload: int
+
+    @property
+    def kind_name(self) -> str:
+        return (EVENT_KIND_NAMES[self.kind]
+                if 0 <= self.kind < len(EVENT_KIND_NAMES)
+                else f"kind{self.kind}")
+
+
+def trace_counts(state, capacity: Optional[int] = None):
+    """(recorded, dropped) totals over however many lanes the state
+    carries: recorded = min(count, K) summed, dropped = max(0, count - K)
+    summed — the overflow policy's never-silent surface."""
+    import numpy as np
+
+    count = np.asarray(state.tr_count, dtype=np.int64)
+    k = int(state.tr_meta.shape[-1] if capacity is None else capacity)
+    recorded = np.minimum(count, k).sum()
+    dropped = np.maximum(count - k, 0).sum()
+    return int(recorded), int(dropped)
+
+
+def decode_trace(state, lane: Optional[int] = None) -> List[TraceRecord]:
+    """Unroll a state's trace ring chronologically. ``state`` is a host
+    (numpy) state NamedTuple carrying tr_* leaves; pass ``lane`` to select
+    one lane of a batched state. Events lost to ring wrap are simply
+    absent (their count survives in tr_count — trace_counts)."""
+    import numpy as np
+
+    meta, data, tick, count = (state.tr_meta, state.tr_data,
+                               state.tr_tick, state.tr_count)
+    if lane is not None:
+        meta, data, tick, count = (meta[lane], data[lane],
+                                   tick[lane], count[lane])
+    meta = np.asarray(meta)
+    if meta.ndim != 1:
+        raise ValueError("batched trace state needs an explicit lane=")
+    data, tick = np.asarray(data), np.asarray(tick)
+    k = meta.shape[0]
+    count = int(count)
+    live = min(count, k)
+    out = []
+    for i in range(count - live, count):
+        pos = i % k
+        actor, kind = unpack_event(int(meta[pos]))
+        out.append(TraceRecord(int(tick[pos]), int(kind), int(actor),
+                               int(data[pos])))
+    return out
+
+
+def _event_line(ev: TraceRecord, topo) -> str:
+    """One decoded event in the reference Logger's record string format
+    (common.go:75-122) prefixed with the acting node — the line shape
+    EpochTrace.pretty() emits, so dense and parity traces diff cleanly."""
+    ids = topo.ids
+    if ev.kind in (EV_SEND, EV_RECV, EV_MSEND, EV_MRECV):
+        src = ids[int(topo.edge_src[ev.actor])]
+        dst = ids[int(topo.edge_dst[ev.actor])]
+        if ev.kind == EV_SEND:
+            return f"\t{src}: {src} sent {ev.payload} tokens to {dst}"
+        if ev.kind == EV_RECV:
+            return f"\t{dst}: {dst} received {ev.payload} tokens from {src}"
+        if ev.kind == EV_MSEND:
+            return f"\t{src}: {src} sent marker({ev.payload}) to {dst}"
+        return f"\t{dst}: {dst} received marker({ev.payload}) from {src}"
+    nid = ids[ev.actor] if 0 <= ev.actor < len(ids) else str(ev.actor)
+    if ev.kind == EV_SNAP_START:
+        return f"\t{nid}: {nid} startSnapshot({ev.payload})"
+    if ev.kind == EV_SNAP_END:
+        return f"\t{nid}: {nid} endSnapshot({ev.payload})"
+    if ev.kind in (EV_SUP_ABORT, EV_SUP_RETRY, EV_SUP_FAIL):
+        verb = {EV_SUP_ABORT: "supervisorAbort", EV_SUP_RETRY:
+                "supervisorRetry", EV_SUP_FAIL: "supervisorFail"}[ev.kind]
+        return f"\t{nid}: {nid} {verb}(slot {ev.payload})"
+    if ev.kind == EV_FAULT:
+        return f"\t{nid}: fault(class {ev.payload})"
+    if ev.kind == EV_LANE_ADMIT:
+        return f"\tlane: admit(job {ev.payload})"
+    if ev.kind == EV_LANE_HARVEST:
+        return f"\tlane: harvest(job {ev.payload})"
+    return f"\t?: {ev.kind_name}({ev.payload})"
+
+
+def trace_pretty(events: List[TraceRecord], topo) -> str:
+    """Render decoded events in EpochTrace.pretty()'s exact format:
+    ``Time {t}:`` headers (non-empty ticks only) with one tab-indented
+    record line per event. On a fault-free, supervisor-free run this is
+    byte-comparable to the parity backend's trace
+    (tests/test_trace.py)."""
+    out: List[str] = []
+    last_tick = None
+    for ev in events:
+        if ev.tick != last_tick:
+            out.append(f"Time {ev.tick}:")
+            last_tick = ev.tick
+        out.append(_event_line(ev, topo))
+    return "\n".join(out)
+
+
+def trace_to_perfetto(events: List[TraceRecord], topo,
+                      lane: int = 0, tick_us: int = 1000) -> Dict[str, Any]:
+    """Chrome/Perfetto trace-event JSON for one lane's decoded events:
+    one track (pid=lane, tid=node) per node, message/lane events as
+    instants, snapshot attempts as async spans (ph 'b'/'e' keyed by
+    snapshot id), faults as instants. Load in ui.perfetto.dev or
+    chrome://tracing next to a ``jax.profiler`` xplane capture. Ticks are
+    scaled to ``tick_us`` microseconds so the discrete timeline is
+    scrubbable."""
+    ids = topo.ids
+    tev: List[Dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": lane,
+         "args": {"name": f"lane {lane}"}}]
+    for i, nid in enumerate(ids):
+        tev.append({"name": "thread_name", "ph": "M", "pid": lane,
+                    "tid": i, "args": {"name": f"node {nid}"}})
+    sup_tid = len(ids)
+    tev.append({"name": "thread_name", "ph": "M", "pid": lane,
+                "tid": sup_tid, "args": {"name": "lane/supervisor"}})
+    for ev in events:
+        ts = ev.tick * tick_us
+        if ev.kind in (EV_SEND, EV_MSEND):
+            tid = int(topo.edge_src[ev.actor])
+        elif ev.kind in (EV_RECV, EV_MRECV):
+            tid = int(topo.edge_dst[ev.actor])
+        elif ev.kind in (EV_SNAP_START, EV_SNAP_END, EV_SUP_ABORT,
+                         EV_SUP_RETRY, EV_SUP_FAIL):
+            tid = ev.actor if 0 <= ev.actor < len(ids) else sup_tid
+        elif ev.kind == EV_FAULT:
+            tid = ev.actor if 0 <= ev.actor < len(ids) else sup_tid
+        else:
+            tid = sup_tid
+        base = {"pid": lane, "tid": tid, "ts": ts,
+                "cat": ev.kind_name,
+                "args": {"actor": ev.actor, "payload": ev.payload,
+                         "tick": ev.tick}}
+        if ev.kind == EV_SNAP_START:
+            tev.append({**base, "name": f"snapshot {ev.payload}",
+                        "ph": "b", "id": ev.payload, "cat": "snapshot"})
+        elif ev.kind == EV_SNAP_END:
+            tev.append({**base, "name": f"snapshot {ev.payload}",
+                        "ph": "e", "id": ev.payload, "cat": "snapshot"})
+        elif ev.kind == EV_FAULT:
+            tev.append({**base, "name": f"fault class {ev.payload}",
+                        "ph": "i", "s": "t"})
+        else:
+            tev.append({**base, "name": ev.kind_name, "ph": "i", "s": "t"})
+    return {"traceEvents": tev, "displayTimeUnit": "ms"}
+
+
+TELEMETRY_SCHEMA_VERSION = 1
+
+
+class TelemetryWriter:
+    """Structured JSONL telemetry: one self-describing record per line,
+    each stamped with the schema version so tools/analyze.py (and any
+    downstream consumer) can evolve safely. ``kind`` partitions the
+    stream (run metadata vs per-step metrics vs final summary)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "w")
+
+    def write(self, kind: str, record: Dict[str, Any]) -> None:
+        row = {"schema": TELEMETRY_SCHEMA_VERSION, "kind": kind, **record}
+        self._f.write(json.dumps(row) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_telemetry(path: str) -> List[Dict[str, Any]]:
+    """Parse a telemetry JSONL file, skipping unparseable lines (a killed
+    writer can leave a torn tail) and rejecting records from a NEWER
+    schema than this reader understands."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("schema", 0) > TELEMETRY_SCHEMA_VERSION:
+                raise ValueError(
+                    f"telemetry schema v{row['schema']} is newer than this "
+                    f"reader (v{TELEMETRY_SCHEMA_VERSION})")
+            out.append(row)
+    return out

@@ -26,7 +26,8 @@ def test_chaos_smoke_battery_green():
     # the marker-plane classes under the snapshot supervisor (ISSUE 4)
     assert {"msg-faults", "crash-pause", "crash-lossy-recovered",
             "crash-lossy-unrecovered", "marker-drop-retry",
-            "marker-dup-storm", "marker-drop-exhausted"} <= set(names)
+            "marker-dup-storm", "marker-drop-exhausted",
+            "trace-under-faults"} <= set(names)
     msg = next(r for r in verdict["scenarios"]
                if r["scenario"] == "msg-faults")
     for cls in ("drops", "dups", "jitters"):
@@ -53,3 +54,10 @@ def test_chaos_smoke_battery_green():
     assert exhaust["errors_decoded"] == ["ERR_SNAPSHOT_TIMEOUT"]
     assert exhaust["snapshot_lifecycle"]["failed"] > 0
     assert exhaust["quarantined_lanes"] > 0
+    # the flight recorder captured the supervisor's recovery (ISSUE 7):
+    # abort -> retry -> marker re-send visible in a decoded lane timeline
+    tr = next(r for r in verdict["scenarios"]
+              if r["scenario"] == "trace-under-faults")
+    assert tr["trace_events"] > 0 and tr["trace_dropped"] == 0
+    assert tr["checks"]["abort_retry_reinit_visible"]
+    assert tr["snapshot_lifecycle"]["retried"] > 0

@@ -148,8 +148,8 @@ def test_checkpoint_v6_kill_and_resume_mid_queue(sync_runner, pool,
 
 
 def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
-    # the supported range in the error must have widened to v6 (the
-    # streaming-engine format): an operator holding a too-NEW file learns
+    # the supported range in the error must have widened to v7 (the
+    # flight-recorder format): an operator holding a too-NEW file learns
     # both sides of the mismatch
     path = str(tmp_path / "v99.npz")
     tree = {"x": np.zeros(3, np.int32)}
@@ -158,7 +158,7 @@ def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
     monkeypatch.undo()
     with pytest.raises(CheckpointError,
                        match=r"version 99.*supported version range "
-                             r"v\d+\.\.v6"):
+                             r"v\d+\.\.v7"):
         load_state(path, tree)
 
 

@@ -1,0 +1,171 @@
+"""Device flight recorder (utils/tracing.py): decode parity with the
+reference Logger, overflow accounting, observer-effect zero, checkpoint-v7
+ring round-trip, and the telemetry JSONL contract.
+
+The headline guarantees (ISSUE 7):
+
+  * a recorded dense-backend run decodes to EXACTLY the parity backend's
+    EpochTrace.pretty() output on the reference goldens — the device ring
+    captures the same events at the same sites the reference Logger logs;
+  * arming the trace never perturbs the simulation (every non-trace state
+    leaf bit-identical to the trace=None run — the faults=None pattern);
+  * ring wrap is never silent: the dropped counter accounts for every
+    overwritten event and the ring keeps the chronological TAIL;
+  * the ring rides checkpoints bit-exactly (format v7), so a killed run's
+    resume carries its flight history forward.
+"""
+
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.api import run_events_file
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+from chandy_lamport_tpu.utils.tracing import (
+    JaxTrace,
+    TELEMETRY_SCHEMA_VERSION,
+    TelemetryWriter,
+    read_telemetry,
+    trace_counts,
+)
+
+GOLDEN_IDS = [t[1].removesuffix(".events") for t in REFERENCE_TESTS]
+SMALL_TOP, SMALL_EVENTS = "2nodes.top", "2nodes-message.events"
+
+
+def _run_small(trace=True, config=None):
+    return run_events_file(fixture_path(SMALL_TOP),
+                           fixture_path(SMALL_EVENTS),
+                           backend="jax", trace=trace, config=config)
+
+
+@pytest.fixture(scope="module")
+def small_traced():
+    """One traced dense run of the smallest golden, shared by the fast
+    tests (each distinct trace_capacity is a fresh compile)."""
+    return _run_small()
+
+
+def test_trace_pretty_matches_parity_on_golden(small_traced):
+    _, dsim = small_traced
+    _, psim = run_events_file(fixture_path(SMALL_TOP),
+                              fixture_path(SMALL_EVENTS),
+                              backend="parity", trace=True)
+    assert dsim.trace.pretty() == psim.trace.pretty()
+    rec, dropped = dsim.trace.counts()
+    assert rec == len(dsim.trace.events) and dropped == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=GOLDEN_IDS)
+def test_trace_pretty_matches_parity_all_goldens(top, events, snaps):
+    _, psim = run_events_file(fixture_path(top), fixture_path(events),
+                              backend="parity", trace=True)
+    _, dsim = run_events_file(fixture_path(top), fixture_path(events),
+                              backend="jax", trace=True)
+    assert dsim.trace.pretty() == psim.trace.pretty()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=GOLDEN_IDS)
+def test_trace_off_bit_identity_goldens(top, events, snaps):
+    """Arming the recorder must not move a single bit of simulation state:
+    the traced run's final DenseState equals the trace=None run's on every
+    non-trace leaf (and the snapshots it decodes are identical)."""
+    off_snaps, off = run_events_file(fixture_path(top), fixture_path(events),
+                                     backend="jax", trace=False)
+    on_snaps, on = run_events_file(fixture_path(top), fixture_path(events),
+                                   backend="jax", trace=True)
+    assert off_snaps == on_snaps
+    import jax
+
+    ha = {k: v for k, v in off._host()._asdict().items()
+          if not k.startswith("tr_")}
+    hb = {k: v for k, v in on._host()._asdict().items()
+          if not k.startswith("tr_")}
+    fa, ta = jax.tree_util.tree_flatten(ha)
+    fb, tb = jax.tree_util.tree_flatten(hb)
+    assert ta == tb
+    for xa, xb in zip(fa, fb):
+        assert np.array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def test_trace_wraparound_and_dropped_count(small_traced):
+    """A capacity-4 ring on a 9-event run wraps: the dropped counter owns
+    the difference and the ring holds the chronological tail."""
+    _, full = small_traced
+    all_events = full.trace.events
+    assert len(all_events) > 4
+    _, capped = _run_small(config=SimConfig(trace_capacity=4))
+    rec, dropped = capped.trace.counts()
+    assert rec == 4
+    assert dropped == len(all_events) - 4
+    assert capped.trace.events == all_events[-4:]
+
+
+def test_checkpoint_v7_ring_roundtrip(tmp_path):
+    """Kill -> resume through a checkpoint carries the ring bit-exactly:
+    a storm split in two with a save/load between the chunks finishes with
+    every leaf — tr_* included — identical to the uninterrupted run."""
+    import jax
+
+    from chandy_lamport_tpu.models.workloads import (
+        StormProgram,
+        ring_topology,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.checkpoint import load_state, save_state
+
+    spec = ring_topology(4, tokens=20)
+    cfg = SimConfig.for_workload(snapshots=2)
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(1), batch=2,
+                           trace=JaxTrace(capacity=128))
+    prog = storm_program(
+        runner.topo, phases=8, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 2, 1, 2,
+                                            max_phases=8))
+    full = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+    amounts, snap = np.asarray(prog.amounts), np.asarray(prog.snap)
+    mid = runner.run_storm(runner.init_batch(),
+                           StormProgram(amounts[:4], snap[:4]), drain=False)
+    path = str(tmp_path / "trace_ck.npz")
+    save_state(path, mid, meta={"next_phase": 4})
+    loaded, meta = load_state(path, runner.init_batch())
+    assert meta["next_phase"] == 4
+    # the ring survived the save/load byte-for-byte
+    for name in ("tr_meta", "tr_data", "tr_tick", "tr_count", "tr_on"):
+        assert np.array_equal(np.asarray(getattr(loaded, name)),
+                              np.asarray(jax.device_get(
+                                  getattr(mid, name)))), name
+    resumed = jax.device_get(
+        runner.run_storm(loaded, StormProgram(amounts[4:], snap[4:])))
+    for name, leaf in full._asdict().items():
+        assert np.array_equal(np.asarray(leaf),
+                              np.asarray(getattr(resumed, name))), name
+    rec, dropped = trace_counts(resumed)
+    assert rec > 0 and dropped == 0
+
+
+def test_telemetry_writer_roundtrip(tmp_path):
+    """JSONL contract: schema-stamped records round-trip, torn trailing
+    lines are skipped, and a newer schema version fails loudly."""
+    path = str(tmp_path / "t.jsonl")
+    with TelemetryWriter(path) as tw:
+        tw.write("run", {"value": 1.5, "name": "a"})
+        tw.write("event", {"tick": 3})
+    with open(path, "a") as f:
+        f.write('{"torn": ')  # a crash mid-write must not poison the file
+    records = read_telemetry(path)
+    assert [r["kind"] for r in records] == ["run", "event"]
+    assert all(r["schema"] == TELEMETRY_SCHEMA_VERSION for r in records)
+    assert records[0]["value"] == 1.5 and records[1]["tick"] == 3
+    with open(path, "w") as f:
+        f.write('{"schema": %d, "kind": "run"}\n'
+                % (TELEMETRY_SCHEMA_VERSION + 1))
+    with pytest.raises(ValueError):
+        read_telemetry(path)

@@ -6,7 +6,14 @@ cost-analysis estimates (flops / bytes accessed) plus measured wall-clock per
 component, so the dominant op of the tick is identified even without a
 trace viewer. Use CLSIM_PLATFORM=cpu off-TPU.
 
+``--telemetry FILE`` switches to telemetry mode: instead of compiling
+kernels, summarize a schema-versioned JSONL stream written by the CLI's
+``--telemetry`` flags or ``trace`` subcommand (utils/tracing.
+TelemetryWriter) — per-kind record counts, run-row headlines, and the
+decoded-event histogram.
+
 Usage: python tools/analyze.py [--nodes N] [--batch B] [--scheduler sync]
+       python tools/analyze.py --telemetry runs.jsonl
 """
 
 from __future__ import annotations
@@ -19,6 +26,47 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def analyze_telemetry(path: str) -> None:
+    """Summarize a TelemetryWriter JSONL stream: per-kind counts, run-row
+    headlines, and the event histogram (torn trailing lines are skipped by
+    the reader; a newer schema version fails loudly there)."""
+    from collections import Counter
+
+    from chandy_lamport_tpu.utils.tracing import read_telemetry
+
+    records = read_telemetry(path)
+    if not records:
+        print(f"{path}: no telemetry records")
+        return
+    kinds = Counter(r["kind"] for r in records)
+    print(f"{path}: {len(records)} records "
+          f"(schema {records[0]['schema']})")
+    for kind, cnt in sorted(kinds.items()):
+        print(f"  {kind:<16} {cnt}")
+    # run rows: one headline per row, whatever kind produced it
+    run_keys = ("value", "unit", "trace_events", "trace_dropped",
+                "error_bits", "jobs_done", "snapshots", "wall_seconds")
+    for r in records:
+        if not r["kind"].endswith("_run"):
+            continue
+        fields = {k: r[k] for k in run_keys if k in r}
+        print(f"  {r['kind']}: " + ", ".join(
+            f"{k}={v}" for k, v in fields.items()))
+    events = [r for r in records if r["kind"] == "event"]
+    if events:
+        hist = Counter(e["event"] for e in events)
+        ticks = [e["tick"] for e in events]
+        print(f"  event histogram ({len(events)} events, "
+              f"ticks {min(ticks)}..{max(ticks)}):")
+        for name, cnt in hist.most_common():
+            print(f"    {name:<16} {cnt}")
+    jobs = [r for r in records if r["kind"] == "stream_job"]
+    if jobs:
+        errored = [j for j in jobs if j.get("error")]
+        print(f"  stream jobs: {len(jobs)} harvested, "
+              f"{len(errored)} errored")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=1024)
@@ -27,7 +75,13 @@ def main() -> None:
     p.add_argument("--snapshots", type=int, default=8)
     p.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     p.add_argument("--repeats", type=int, default=20)
+    p.add_argument("--telemetry", metavar="FILE",
+                   help="summarize this JSONL telemetry stream instead of "
+                        "running the kernel cost analysis")
     args = p.parse_args()
+
+    if args.telemetry:
+        return analyze_telemetry(args.telemetry)
 
     platform = os.environ.get("CLSIM_PLATFORM")
     import jax

@@ -253,6 +253,67 @@ def main() -> int:
         f"errored={len(errored)}"
         f"{'' if row['ok'] else ' checks=' + str(checks)}")
 
+    # -- flight recorder under faults (ISSUE 7): the decoded device
+    #    timeline must SHOW the supervisor's recovery, not just count it.
+    #    Same marker-drop storm as marker-drop-retry but with the trace
+    #    armed: some lane's event stream must carry supervisor-abort
+    #    followed by supervisor-retry followed by a fresh marker-send —
+    #    the re-initiation, readable straight off the ring.
+    from chandy_lamport_tpu.utils.tracing import (
+        EV_MSEND,
+        EV_SUP_ABORT,
+        EV_SUP_RETRY,
+        JaxTrace,
+        decode_trace,
+        trace_counts,
+    )
+
+    adversary = JaxFaults(s, marker_drop_rate=0.1)
+    runner = BatchedRunner(ring, sup_cfg, FixedJaxDelay(1), batch=args.batch,
+                           scheduler="exact", faults=adversary,
+                           quarantine=True, trace=JaxTrace())
+    prog = storm_program(
+        runner.topo, phases=24, amount=1,
+        snapshot_phases=staggered_snapshots(runner.topo, 1, 1, 2,
+                                            max_phases=24))
+    final = jax.device_get(runner.run_storm(runner.init_batch(), prog))
+    summary = BatchedRunner.summarize(final)
+    lc = summary["snapshot_lifecycle"]
+    delta = int(conservation_delta(
+        final, sup_cfg, int(runner.topo.tokens0.sum()) * args.batch))
+    rec, dropped = trace_counts(final)
+    seq_ok = False
+    for lane in range(args.batch):
+        evs = decode_trace(final, lane=lane)
+        t_abort = next((e.tick for e in evs if e.kind == EV_SUP_ABORT), None)
+        if t_abort is None:
+            continue
+        t_retry = next((e.tick for e in evs
+                        if e.kind == EV_SUP_RETRY and e.tick >= t_abort),
+                       None)
+        if t_retry is not None and any(
+                e.kind == EV_MSEND and e.tick > t_retry for e in evs):
+            seq_ok = True
+            break
+    checks = {
+        "supervisor_retried": lc["retried"] > 0,
+        "all_completed": lc["completed"] == lc["initiated"],
+        "recovered_clean": summary["error_lanes"] == 0,
+        "books_balance": delta == 0,
+        "events_recorded": rec > 0 and dropped == 0,
+        "abort_retry_reinit_visible": seq_ok,
+    }
+    row = {"scenario": "trace-under-faults",
+           "trace_events": rec, "trace_dropped": dropped,
+           "conservation_delta": delta,
+           "snapshot_lifecycle": lc, "checks": checks,
+           "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"trace-under-faults: {'ok' if row['ok'] else 'FAIL'} "
+        f"events={rec} retried={lc['retried']}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
     verdict = {"ok": ok, "scenarios": rows,
                "elapsed_s": round(time.time() - t0, 1)}
     print(json.dumps(verdict))

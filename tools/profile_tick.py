@@ -392,6 +392,41 @@ def main() -> None:
                   f"({(t / sbase - 1) * 100:+6.2f}% vs off)",
                   file=sys.stderr)
 
+    # ---- flight-recorder overhead: the compiled-away-when-off claim, ----
+    # measured (same template as the faults section). Three kernels at the
+    # same shape: trace off (trace=None — the ring writes do not exist in
+    # the compiled kernel, the bit-identity tests/test_trace.py pins), armed
+    # but runtime-disarmed (tr_on=0: every scatter still compiled in, every
+    # append mask forced False — the pure instruction tax), and recording
+    # (tr_on=1, events landing in the ring every tick).
+    from chandy_lamport_tpu.utils.tracing import JaxTrace
+
+    tr_runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
+                              batch=args.batch, scheduler=args.scheduler,
+                              exact_impl=args.exact_impl,
+                              megatick=args.megatick,
+                              queue_engine=args.queue_engine,
+                              trace=JaxTrace())
+    ttick = jax.jit(jax.vmap(tr_runner._tick_fn), donate_argnums=0)
+    ttimings = {"off": per_tick}
+    for tname, armed in (("armed-idle", 0), ("recording", 1)):
+        st = tr_runner.init_batch_device()
+        st = st._replace(tr_on=jax.numpy.full_like(st.tr_on, armed))
+        st = ttick(st)                            # compile + warm
+        jax.block_until_ready(st)
+        t0 = time.perf_counter()
+        for _ in range(args.ticks):
+            st = ttick(st)
+        jax.block_until_ready(st)
+        ttimings[tname] = (time.perf_counter() - t0) / args.ticks
+    print(f"flight recorder (ring writes on the hot path, "
+          f"K={tr_runner.config.trace_capacity}):", file=sys.stderr)
+    for tname in ("off", "armed-idle", "recording"):
+        t = ttimings[tname]
+        print(f"  {tname:<12} {t * 1e3:9.3f} ms/tick "
+              f"({(t / per_tick - 1) * 100:+6.2f}% vs off)",
+              file=sys.stderr)
+
     if args.scheduler == "exact":
         # per-stage wall-clock of the fused exact path: how much of a
         # dispatch is tick-start delivery selection (_select_and_pop, the
