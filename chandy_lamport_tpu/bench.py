@@ -146,6 +146,18 @@ def _parser() -> argparse.ArgumentParser:
                         "ops/tick.resolve_queue_engine). Bit-identical "
                         "results; the JSON row's queue_engine field "
                         "records the RESOLVED engine")
+    p.add_argument("--comm-engine", choices=["auto", "dense", "sparse"],
+                   default="auto",
+                   help="--graphshard only: cross-shard traffic engine "
+                        "(parallel/graphshard): 'dense' = full-plane "
+                        "psum/all_gather + incidence matmuls, 'sparse' = "
+                        "boundary-edge halo exchange over ppermute with "
+                        "O(E_local) segment reductions, 'auto' (default) = "
+                        "ops/tick.resolve_comm_engine. Bit-identical "
+                        "results; the JSON row records the RESOLVED engine "
+                        "plus the analytic comm_bytes_model. With "
+                        "--graphshard, --megatick K also fuses K drain "
+                        "ticks per dispatch inside the shard_map body")
     p.add_argument("--capacity", type=int, default=0,
                    help="per-edge queue slots; 0 = size to the workload "
                         "(SimConfig.for_workload)")
@@ -834,7 +846,9 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         return 1
     mesh = Mesh(np.array(devs[:args.graphshard]), ("graph",))
     runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
-                                queue_engine=args.queue_engine)
+                                queue_engine=args.queue_engine,
+                                comm_engine=args.comm_engine,
+                                megatick=args.megatick)
     topo = runner.topo
     log(f"graphshard: {topo.n} nodes / {args.graphshard} shards "
         f"({runner.nl} nodes, {runner.em} edge slots per shard), "
@@ -872,7 +886,9 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         log(f"retrying with queue_capacity={cfg.queue_capacity}, "
             f"max_recorded={cfg.max_recorded}")
         runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
-                                    queue_engine=args.queue_engine)
+                                    queue_engine=args.queue_engine,
+                                    comm_engine=args.comm_engine,
+                                    megatick=args.megatick)
 
     times, ticks_seen = [], []
     mem = {}
@@ -907,6 +923,11 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "device_kind": dev.device_kind,
         "scheduler": "sync",
         "queue_engine": runner.queue_engine,
+        "comm_engine": runner.comm_engine,
+        "megatick": runner.megatick,
+        # analytic per-shard per-tick bytes for both engines at THIS
+        # partition's cut (utils/metrics.comm_bytes_model)
+        "comm_bytes_model": runner.comm_model(),
         "mode": "graphshard",
         "graphshard": args.graphshard,
         "graph": args.graph,

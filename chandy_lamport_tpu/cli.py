@@ -647,7 +647,12 @@ def main(argv=None) -> int:
                          "per harvested job as schema-versioned JSONL")
     pq.set_defaults(fn=_cmd_stream)
 
-    pb = sub.add_parser("bench", help="node-ticks/sec benchmark")
+    pb = sub.add_parser(
+        "bench", help="node-ticks/sec benchmark",
+        description="Forwards everything after 'bench' to bench.py "
+                    "(--scheduler, --stream, --graphshard P with "
+                    "--comm-engine dense|sparse|auto and --megatick K, "
+                    "--queue-engine, ...); one JSON row on stdout.")
     pb.add_argument("bench_args", nargs=argparse.REMAINDER)
     pb.set_defaults(fn=_cmd_bench)
 

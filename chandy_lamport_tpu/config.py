@@ -67,6 +67,18 @@ class SimConfig:
     # "segsum" uses O(E) integer prefix-sum segment reductions (exact at
     # any scale, no large constants). "auto" picks by graph size.
     reduce_mode: str = "auto"
+    # How the graph-sharded runner (parallel/graphshard.py) moves per-tick
+    # state across shards: "dense" exchanges the full [N] credit / [S, N]
+    # arrival / [S, N] created planes via psum + all_gather and spreads
+    # them through [N_local, Em] incidence matmuls; "sparse" reduces local
+    # edge contributions with O(E_local) segment sums and exchanges only
+    # the packed boundary rows — one lax.ppermute per neighbor pair over a
+    # static ring schedule — so bytes scale with the partition CUT, not N
+    # (utils/metrics.comm_bytes_model gives both curves). "auto" defers to
+    # ops/tick.resolve_comm_engine (currently "sparse" everywhere). Both
+    # engines are bit-identical to the unsharded sync kernel; a runner
+    # kwarg overrides this per-instance.
+    comm_engine: str = "auto"
     # Snapshot supervisor (ops/tick.TickKernel._supervise): with
     # snapshot_timeout > 0, a started snapshot that has not completed
     # within that many ticks of its (re-)initiation is aborted IN TRACE —
@@ -116,6 +128,8 @@ class SimConfig:
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
+        if self.comm_engine not in ("auto", "dense", "sparse"):
+            raise ValueError("comm_engine must be 'auto', 'dense' or 'sparse'")
         if (self.snapshot_timeout < 0 or self.snapshot_retries < 0
                 or self.snapshot_every < 0):
             raise ValueError(

@@ -142,6 +142,28 @@ def resolve_queue_engine(engine: str, backend: str | None = None) -> str:
     return "gather" if backend == "tpu" else "mask"
 
 
+def resolve_comm_engine(engine: str, backend: str | None = None) -> str:
+    """Resolve the graph-sharded cross-shard traffic knob
+    (parallel/graphshard.GraphShardedRunner): "dense" keeps the full-plane
+    psum/all_gather collectives plus the [N_local, Em] incidence matmuls;
+    "sparse" runs the boundary-edge halo exchange — O(E_local) segment
+    sums, then only the packed cut rows move, one ppermute per neighbor
+    pair. "auto" resolves to "sparse" on every backend: its per-tick bytes
+    scale with the partition cut (comm_bytes_model in utils/metrics.py)
+    instead of N, its reductions are integer-exact adds in any order, and
+    the CPU-mesh A/B in tools/profile_tick.py ("graphshard comm") shows it
+    no slower even at small N where the dense planes still fit. "dense"
+    is retained as the in-tree differential oracle. ``backend`` is
+    accepted for symmetry with resolve_queue_engine / count_dtype should
+    a backend ever want the dense plane back."""
+    if engine not in ("auto", "dense", "sparse"):
+        raise ValueError(f"unknown comm_engine {engine!r}")
+    if engine != "auto":
+        return engine
+    del backend  # same resolution everywhere, see docstring
+    return "sparse"
+
+
 def merge_keymult(max_snapshots: int) -> int:
     """Split-mode FIFO merge-key multiplier: m_key = tok_before * KEYMULT +
     marker_ord (DenseState docstring). marker_ord < S (each slot pushes each

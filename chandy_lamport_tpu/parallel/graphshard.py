@@ -15,13 +15,24 @@ Partitioning invariants that make the sync scheduler shard-local:
   - per-(slot, node) snapshot state (frozen/rem/has/done) lives on the
     node's shard; per-(slot, edge) recording state lives with the edge.
 
-Collectives per tick (all small, all over ICI):
-  - psum of per-node token credits [N] (cross-shard token deliveries);
-  - psum of per-(slot, node) marker arrivals [S, N];
-  - all_gather of created-this-tick [S, N_local] -> [S, N] so source shards
-    can update recording flags and enqueue re-broadcast markers for remote
-    creators;
-  - psum of per-slot finalization counts and the error bitmask.
+Cross-shard traffic per tick comes in two engines (``comm_engine``,
+SimConfig / runner kwarg, resolved by ops/tick.resolve_comm_engine):
+  - "dense": psum of per-node token credits [N], psum of per-(slot, node)
+    marker arrivals [S, N], all_gather of created-this-tick [S, N_local]
+    -> [S, N] so source shards can update recording flags and enqueue
+    re-broadcast markers for remote creators — plus [N_local, Em]
+    incidence matmuls to spread the gathered planes back onto edges;
+  - "sparse" (the default resolution): a boundary-edge halo exchange —
+    local contributions reduce in O(E_local) with the segment-sum
+    machinery from ops/tick.py over partition-time tables
+    (parallel/mesh.boundary_tables), then ONLY the packed cut rows move,
+    one lax.ppermute per ring distance d = 1..P-1 forward (credits +
+    marker arrivals) and one back (created flags), scattered into the
+    local planes with static index tables. All exchanged quantities are
+    integer adds / boolean ORs, so accumulation order cannot perturb
+    results: both engines are bit-identical to the unsharded kernel.
+  - either way: psum of per-slot finalization counts and the error
+    bitmask (the latter amortized to phase/megatick boundaries).
 
 Per-shard topology constants ride in as sharded ARGUMENTS (stacked on the
 shard axis) rather than closure constants, so one shard_map body serves every
@@ -87,13 +98,23 @@ class ShardedTopology(NamedTuple):
 
     edge_src: Any    # i32 [P, Em]  global src node id, -1 pad
     edge_dst: Any    # i32 [P, Em]  global dst node id, -1 pad
-    a_in: Any        # f32 [P, N, Em]  one-hot dst incidence (0 for pads)
+    a_in: Any        # f32 [P, N, Em]  one-hot dst incidence (0 for pads;
+    #                  [P, 1, 1] zeros when comm_engine="sparse" — the
+    #                  halo exchange never reads the dense planes, so the
+    #                  O(N * Em) constants are not materialized)
     a_in_c: Any      # cnt [P, N, Em]
     a_src_c: Any     # cnt [P, N, Em]  one-hot src incidence (0 for pads)
     src_first: Any   # i32 [P, Em] local index of each edge's source's first
     #                  edge (pads point at themselves) — O(Em) same-source
     #                  predecessor test via prefix counts, replacing the old
     #                  O(Em^2) strict-predecessor matrix
+    # sparse halo-exchange tables (parallel/mesh.BoundaryTables docstring
+    # for the layout; [P, 0-size] when comm_engine="dense" or cut is empty)
+    dst_seg: Any     # i32 [P, Em]        combined segment / flags index
+    seg_perm: Any    # i32 [P, Em]        stable sort into segment order
+    seg_lo: Any      # i32 [P, Nl+R+1]    segment bounds
+    seg_hi: Any      # i32 [P, Nl+R+1]
+    recv_idx: Any    # i32 [P, P-1, H]    scatter (fwd) / gather (rev) rows
     in_degree: Any   # i32 [N] (replicated)
 
 
@@ -181,11 +202,20 @@ class ShardedState(NamedTuple):
     error: Any       # i32 [] (replicated)
 
 
-def shard_topology(topo: DenseTopology, shards: int,
-                   cnt_dtype=None) -> Tuple[ShardedTopology, int]:
+def shard_topology(topo: DenseTopology, shards: int, cnt_dtype=None,
+                   incidence: bool = True):
     """Partition nodes into contiguous blocks and edges by source shard;
     pad per-shard edge arrays to the max local count. ``cnt_dtype`` is the
-    count-matmul dtype for the ``_c`` constants (default f32)."""
+    count-matmul dtype for the ``_c`` constants (default f32).
+    ``incidence=False`` (the sparse comm engine) replaces the O(N * Em)
+    one-hot incidence constants with [P, 1, 1] zeros — the halo exchange
+    never reads them, and at giant N they would dominate HBM.
+
+    Returns (ShardedTopology, Em, parallel/mesh.BoundaryTables) — the
+    boundary tables are always built (cheap host numpy) so cut statistics
+    and the comm-bytes model are available under either engine."""
+    from chandy_lamport_tpu.parallel.mesh import boundary_tables
+
     n, e = topo.n, topo.e
     if n % shards:
         raise ValueError(f"nodes ({n}) must divide evenly into {shards} shards")
@@ -201,17 +231,20 @@ def shard_topology(topo: DenseTopology, shards: int,
         edge_src[p, fill[p]] = topo.edge_src[i]
         edge_dst[p, fill[p]] = topo.edge_dst[i]
         fill[p] += 1
-    a_in = np.zeros((shards, n, em), np.float32)
-    a_src = np.zeros((shards, n, em), np.float32)
+    ishape = (shards, n, em) if incidence else (shards, 1, 1)
+    a_in = np.zeros(ishape, np.float32)
+    a_src = np.zeros(ishape, np.float32)
     src_first = np.tile(np.arange(em, dtype=np.int32), (shards, 1))
     for p in range(shards):
-        for j in range(int(counts[p])):
-            a_in[p, edge_dst[p, j], j] = 1.0
-            a_src[p, edge_src[p, j], j] = 1.0
+        if incidence:
+            for j in range(int(counts[p])):
+                a_in[p, edge_dst[p, j], j] = 1.0
+                a_src[p, edge_src[p, j], j] = 1.0
         # local edges keep global (src, dst) order, so src is nondecreasing
         # over the real prefix; pads (tail) keep the identity default
         row = edge_src[p, :int(counts[p])]
         src_first[p, :int(counts[p])] = np.searchsorted(row, row, side="left")
+    bt = boundary_tables(edge_src, edge_dst, shards, nl)
     a_in_f = jnp.asarray(a_in)
     cnt = jnp.dtype(cnt_dtype) if cnt_dtype is not None else jnp.dtype(jnp.float32)
     return ShardedTopology(
@@ -220,8 +253,11 @@ def shard_topology(topo: DenseTopology, shards: int,
         a_in_c=a_in_f if cnt == jnp.float32 else jnp.asarray(a_in, cnt),
         a_src_c=jnp.asarray(a_src, cnt),
         src_first=jnp.asarray(src_first),
+        dst_seg=jnp.asarray(bt.dst_seg), seg_perm=jnp.asarray(bt.seg_perm),
+        seg_lo=jnp.asarray(bt.seg_lo), seg_hi=jnp.asarray(bt.seg_hi),
+        recv_idx=jnp.asarray(bt.recv_idx),
         in_degree=jnp.asarray(topo.in_degree),
-    ), em
+    ), em, bt
 
 
 class GraphShardedRunner:
@@ -237,6 +273,7 @@ class GraphShardedRunner:
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
                  max_delay: int = 5, fixed_delay: Optional[int] = None,
                  check_every: int = 0, queue_engine: str = "auto",
+                 comm_engine: Optional[str] = None, megatick: int = 1,
                  quarantine: bool = False, trace=None):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
@@ -254,6 +291,24 @@ class GraphShardedRunner:
         one-hot formulation, "auto" (default) = backend-resolved
         (ops/tick.resolve_queue_engine). All ring state is shard-local,
         so the choice changes no collective.
+
+        comm_engine: cross-shard traffic engine (module docstring):
+        "dense" = full-plane psum/all_gather + incidence matmuls,
+        "sparse" = boundary-edge halo exchange over lax.ppermute with
+        O(E_local) segment reductions, "auto" = ops/tick.
+        resolve_comm_engine. None (default) defers to
+        SimConfig.comm_engine. Bit-identical either way.
+
+        megatick: K >= 1 — the drain loop advances K cond-gated ticks
+        per while_loop body via an in-shard lax.scan, so host dispatch
+        and the psum that folds the shard-local deferred error bits into
+        the replicated sticky mask amortize to the K boundary (the same
+        cadence idea as check_every). Each scanned tick is gated on the
+        live drain predicate (pending & budget, replicated), so K never
+        overshoots: results are bit-identical for ANY K. The one caveat
+        is quarantine: its freeze reads the replicated error mask, which
+        under K > 1 is up to K-1 ticks stale, so an ERRORING quarantined
+        run may freeze later than with K=1 (clean runs are unaffected).
 
         quarantine: freeze the instance the moment its (replicated)
         sticky error bits fire — storm phases, drain and flush all treat
@@ -280,6 +335,13 @@ class GraphShardedRunner:
         self.check_every = int(check_every)
         self.quarantine = bool(quarantine)
         self.queue_engine = resolve_queue_engine(queue_engine)
+        from chandy_lamport_tpu.ops.tick import resolve_comm_engine
+
+        self.comm_engine = resolve_comm_engine(
+            self.config.comm_engine if comm_engine is None else comm_engine)
+        if megatick < 1:
+            raise ValueError("megatick must be >= 1")
+        self.megatick = int(megatick)
         # snapshot supervisor (SimConfig.snapshot_timeout/_every): the
         # sharded twin of TickKernel._supervise — replicated scan/abort
         # state, shard-local plane clears, cond-gated re-initiation
@@ -306,9 +368,11 @@ class GraphShardedRunner:
         self._rec_limit = jnp.iinfo(self._rec_dtype).max
         self._keymult = merge_keymult(self.config.max_snapshots)
         self._key_limit = merge_key_limit(self.config.max_snapshots)
-        self.stopo, self.em = shard_topology(self.topo, self.shards,
-                                             cnt_dtype=self._cnt)
+        self.stopo, self.em, self._bt = shard_topology(
+            self.topo, self.shards, cnt_dtype=self._cnt,
+            incidence=self.comm_engine == "dense")
         self.nl = self.topo.n // self.shards
+        self.halo = self._bt.halo  # max boundary rows per neighbor pair
 
         # global edge -> (owning shard, local slot) in shard fill order;
         # used by shard_program and the event-script compiler
@@ -325,6 +389,8 @@ class GraphShardedRunner:
         topo_specs = ShardedTopology(
             edge_src=spec_sharded, edge_dst=spec_sharded, a_in=spec_sharded,
             a_in_c=spec_sharded, a_src_c=spec_sharded, src_first=spec_sharded,
+            dst_seg=spec_sharded, seg_perm=spec_sharded,
+            seg_lo=spec_sharded, seg_hi=spec_sharded, recv_idx=spec_sharded,
             in_degree=spec_rep)
         state_specs = ShardedState(
             time=spec_rep, tokens=spec_sharded, q_data=spec_sharded, q_meta=spec_sharded,
@@ -566,10 +632,23 @@ class GraphShardedRunner:
                               created_global) -> ShardedState:
         """created_global [S, N] replicated: freeze/record/broadcast for
         every created (slot, node); remote creators reach this shard's
-        recording flags + queues through the replicated created matrix."""
+        recording flags + queues through the replicated created matrix.
+        No collective either way — under "sparse" the O(S * N * Em)
+        incidence matmuls become O(S * Em) gathers on the edge endpoints
+        (the incidence constants are not even materialized then)."""
         S = self.config.max_snapshots
-        created_f = created_global.astype(self._cnt)
-        created_dst_se = (created_f @ st.a_in_c) > 0.5  # [S, Em]
+        if self.comm_engine == "sparse":
+            valid = st.edge_src >= 0
+            created_dst_se = jnp.take(
+                created_global, jnp.clip(st.edge_dst, 0, self.topo.n - 1),
+                axis=-1) & valid[None, :]                    # [S, Em]
+            push_se = jnp.take(
+                created_global, jnp.clip(st.edge_src, 0, self.topo.n - 1),
+                axis=-1) & valid[None, :]
+        else:
+            created_f = created_global.astype(self._cnt)
+            created_dst_se = (created_f @ st.a_in_c) > 0.5   # [S, Em]
+            push_se = (created_f @ st.a_src_c) > 0.5
         created_l = self._my_slice(created_global)           # [S, Nl]
         s = s._replace(
             recording=s.recording | created_dst_se,
@@ -579,12 +658,21 @@ class GraphShardedRunner:
             has_local=s.has_local | created_l,
             **window_update(s, created_dst_se, None, s.rec_cnt),
         )
-        push_se = (created_f @ st.a_src_c) > 0.5  # [S, Em]
         return self._push_markers_split(s, st, push_se)
 
+    def _fold_err(self, s: ShardedState, erl) -> ShardedState:
+        """Union the shard-local deferred error bits into the replicated
+        sticky mask (one 9-bit psum). Callers accumulate into ``erl``
+        through a phase / megatick block and fold at its boundary; the
+        replicated ``s.error`` is the ONLY mask SPMD gating predicates
+        may read, so deferral never de-syncs the shards."""
+        return s._replace(error=s.error | self._por(erl))
+
     def _bulk_send(self, s: ShardedState, st: ShardedTopology,
-                   amounts) -> ShardedState:
-        """amounts [Em] local (sends originate on this shard's sources)."""
+                   amounts, erl):
+        """amounts [Em] local (sends originate on this shard's sources).
+        Returns (state, erl) — local error bits accumulate into ``erl``
+        for the caller's boundary _fold_err instead of psumming here."""
         amounts = jnp.asarray(amounts, _i32)
         active = amounts > 0
         # debit senders with an exact integer segment sum over local edges
@@ -604,11 +692,11 @@ class GraphShardedRunner:
                      | (jnp.any(amounts >= F32_EXACT_LIMIT)
                         | jnp.any(debits_f >= F32_EXACT_LIMIT)
                         ).astype(_i32) * ERR_VALUE_OVERFLOW)
-        s = s._replace(tokens=tokens, error=s.error | self._por(err_local))
+        s = s._replace(tokens=tokens)
         rts, key = self._draw_many(s.delay_key, s.time, active.shape)
         s, err = self._append_active(s._replace(delay_key=key),
                                      active, rts, amounts)
-        return s._replace(error=s.error | self._por(err))
+        return s, erl | err_local | err
 
     def _bulk_snapshots(self, s: ShardedState, st: ShardedTopology,
                         init_mask_n) -> ShardedState:
@@ -647,12 +735,12 @@ class GraphShardedRunner:
         return self._create_and_broadcast(s, st, created)
 
     def _inject_send_local(self, s: ShardedState, st: ShardedTopology,
-                           eloc, amt, active) -> ShardedState:
+                           eloc, amt, active, erl):
         """One script send op, masked: only the shard owning the edge debits
-        and enqueues; every shard runs the same code (and the same _por
-        collective) so the SPMD schedules stay aligned. Mirrors
-        TickKernel._inject_send semantics (debit at send time,
-        node.go:112-131)."""
+        and enqueues; every shard runs the same code so the SPMD schedules
+        stay aligned. Returns (state, erl) — error bits accumulate for the
+        caller's boundary _fold_err. Mirrors TickKernel._inject_send
+        semantics (debit at send time, node.go:112-131)."""
         C = self.config.queue_capacity
         e = jnp.clip(eloc, 0, self.em - 1)
         amt_i = jnp.asarray(amt, _i32)
@@ -679,12 +767,10 @@ class GraphShardedRunner:
             q_len=s.q_len.at[e].add(a),
             tok_pushed=s.tok_pushed.at[e].add(a),
             delay_key=key,
-            error=s.error | self._por(
-                err_local
-                | (a & ((s.tok_pushed[e] >= self._key_limit)
-                        | (rt >= RTIME_PACK_LIMIT))).astype(_i32)
-                * ERR_VALUE_OVERFLOW),
-        )
+        ), erl | err_local | (
+            (a & ((s.tok_pushed[e] >= self._key_limit)
+                  | (rt >= RTIME_PACK_LIMIT))).astype(_i32)
+            * ERR_VALUE_OVERFLOW)
 
     def _supervise(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
         """The sharded snapshot supervisor (TickKernel._supervise's twin):
@@ -748,8 +834,72 @@ class GraphShardedRunner:
                         lambda s: self._create_and_broadcast(s, st, created),
                         lambda s: s, s)
 
-    def _sync_tick(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
-        """The sync scheduler with the cross-shard steps as collectives."""
+    def _sparse_reduce_exchange(self, st: ShardedTopology, amt, mk_se):
+        """The sparse engine's forward half: one fused [S+1, Em] payload
+        (row 0 = token amounts, rows 1.. = marker-arrival counts) reduced
+        into the combined segment space — local destinations first, then
+        the packed per-neighbor boundary rows — with the O(E_local)
+        cumsum segment machinery (TickKernel._segment_sums), then ONE
+        lax.ppermute per ring distance d moving only the [S+1, H] cut
+        rows, scattered into the local planes through the static
+        recv_idx table (pad rows index Nl and drop). Integer adds only,
+        so accumulation order cannot perturb the result: returns exactly
+        the (credit [Nl], arrivals [S, Nl]) the dense psums produce."""
+        from chandy_lamport_tpu.ops.tick import TickKernel
+
+        nl, h, p = self.nl, self.halo, self.shards
+        payload = jnp.concatenate(
+            [amt[None, :], mk_se.astype(_i32)], axis=0)       # [S+1, Em]
+        ordered = jnp.take(payload, st.seg_perm, axis=-1)
+        segs = TickKernel._segment_sums(ordered, st.seg_lo, st.seg_hi)
+        credit_l = segs[0, :nl]                               # [Nl]
+        arrivals_l = segs[1:, :nl]                            # [S, Nl]
+        if p > 1 and h:                                       # static elision
+            out = segs[:, nl:nl + (p - 1) * h].reshape(-1, p - 1, h)
+            for d in range(1, p):
+                recv = lax.ppermute(
+                    out[:, d - 1], self.axis,
+                    perm=[(i, (i + d) % p) for i in range(p)])  # [S+1, H]
+                idx = st.recv_idx[d - 1]
+                credit_l = credit_l.at[idx].add(recv[0], mode="drop")
+                arrivals_l = arrivals_l.at[:, idx].add(recv[1:], mode="drop")
+        return credit_l, arrivals_l
+
+    def _sparse_created_spread(self, st: ShardedTopology, created_l):
+        """The reverse half: each shard owes its neighbors the created
+        flags of exactly the rows it received credit for, so the SAME
+        recv_idx table gathers the [S, H] outgoing block for distance d
+        (pad rows read False) and the reversed ppermute returns it to
+        the sender; dst_seg then reads every edge's destination flag out
+        of [local flags ++ received blocks ++ one zero column] — the
+        sparse stand-in for all_gather + the a_in_c matmul. The source
+        spread needs no communication at all: every edge lives on its
+        source's shard."""
+        nl, h, p = self.nl, self.halo, self.shards
+        blocks = [created_l]
+        if p > 1 and h:
+            for d in range(1, p):
+                idx = st.recv_idx[d - 1]
+                send = (jnp.take(created_l, jnp.minimum(idx, nl - 1),
+                                 axis=-1)
+                        & (idx < nl)[None, :])                # [S, H]
+                blocks.append(lax.ppermute(
+                    send, self.axis,
+                    perm=[(i, (i - d) % p) for i in range(p)]))
+        flags = jnp.concatenate(
+            blocks + [jnp.zeros_like(created_l[:, :1])], axis=-1)
+        created_dst_se = jnp.take(flags, st.dst_seg, axis=-1)  # [S, Em]
+        base = lax.axis_index(self.axis) * nl
+        src_l = jnp.clip(st.edge_src - base, 0, nl - 1)
+        push_se = (jnp.take(created_l, src_l, axis=-1)
+                   & (st.edge_src >= 0)[None, :])              # [S, Em]
+        return created_dst_se, push_se
+
+    def _sync_tick(self, s: ShardedState, st: ShardedTopology, erl):
+        """The sync scheduler with the cross-shard steps as collectives
+        (dense plane) or the boundary halo exchange (sparse). Returns
+        (state, erl): local error bits defer to the caller's boundary
+        _fold_err."""
         cfg = self.config
         C, S, M = cfg.queue_capacity, cfg.max_snapshots, cfg.max_recorded
         time = s.time + 1
@@ -785,39 +935,61 @@ class GraphShardedRunner:
         s = s._replace(q_head=(s.q_head + tok) % C,
                        q_len=s.q_len - tok.astype(_i32))
 
-        # tokens: cross-shard credit via psum of per-node partials
+        # the consumed marker per delivering edge is its front pending
+        # entry (plane index == snapshot id); computed up front because
+        # the sparse engine fuses the marker-arrival rows into the credit
+        # exchange payload
+        mk_se = m_is_front & mk[None, :]
         amt = jnp.where(tok, head_amt, 0)
-        credit_n = lax.psum(st.a_in @ amt.astype(_f32), self.axis)  # [N]
-        # f32 reductions exact only below 2^24 (same guard as the unsharded
-        # sync tick); psum makes the threshold check see the global credit
-        inexact = (jnp.any(amt >= F32_EXACT_LIMIT)
-                   | jnp.any(credit_n >= F32_EXACT_LIMIT)).astype(_i32)
-        s = s._replace(
-            tokens=s.tokens
-            + self._my_slice(credit_n[None, :])[0].astype(_i32),
-            error=s.error | self._por(inexact * ERR_VALUE_OVERFLOW))
+        if self.comm_engine == "sparse":
+            # one fused segment reduction + boundary-row halo exchange
+            credit_l, arrivals_l = self._sparse_reduce_exchange(
+                st, amt, mk_se)
+            # the i32 segment sums are exact at any magnitude, but the
+            # guard must flag the SAME global condition as the unsharded
+            # kernel's f32-exactness check — per-node credit is identical
+            # either way, so testing the local slice and letting the
+            # boundary _fold_err union it reproduces the dense verdict
+            inexact = (jnp.any(amt >= F32_EXACT_LIMIT)
+                       | jnp.any(credit_l >= F32_EXACT_LIMIT)).astype(_i32)
+            s = s._replace(tokens=s.tokens + credit_l)
+        else:
+            # tokens: cross-shard credit via psum of per-node partials;
+            # f32 reductions exact only below 2^24 (same guard as the
+            # unsharded sync tick); psum makes the check see the global
+            # credit
+            credit_n = lax.psum(st.a_in @ amt.astype(_f32), self.axis)
+            inexact = (jnp.any(amt >= F32_EXACT_LIMIT)
+                       | jnp.any(credit_n >= F32_EXACT_LIMIT)).astype(_i32)
+            s = s._replace(
+                tokens=s.tokens
+                + self._my_slice(credit_n[None, :])[0].astype(_i32))
+        erl = erl | inexact * ERR_VALUE_OVERFLOW
         # shared-log append, shard-local (one definition with the dense
-        # kernel: ops/tick.log_append); the error bits psum across shards
+        # kernel: ops/tick.log_append); error bits defer to the fold
         log, cnt, err_bits = log_append(
             s.log_amt, s.rec_cnt, s.min_prot, s.recording,
             tok, amt, self._rec_dtype, self._rec_limit, M)
-        s = s._replace(log_amt=log, rec_cnt=cnt,
-                       error=s.error | self._por(err_bits))
+        s = s._replace(log_amt=log, rec_cnt=cnt)
+        erl = erl | err_bits
 
-        # markers: the consumed marker per delivering edge is its front
-        # pending entry (plane index == snapshot id); arrivals via psum,
-        # creations via all_gather
-        mk_se = m_is_front & mk[None, :]
         s = s._replace(m_pending=s.m_pending & ~mk_se)
-        arrivals_n = lax.psum(mk_se.astype(self._cnt) @ st.a_in_c.T,
-                              self.axis).astype(_i32)          # [S, N]
-        arrivals_l = self._my_slice(arrivals_n)                # [S, Nl]
         had_l = s.has_local
-        created_l = (arrivals_l > 0) & ~had_l
-        created_n = lax.all_gather(created_l, self.axis, axis=1,
-                                   tiled=True)                 # [S, N]
-        created_f = created_n.astype(self._cnt)
-        created_dst_se = (created_f @ st.a_in_c) > 0.5
+        if self.comm_engine == "sparse":
+            created_l = (arrivals_l > 0) & ~had_l
+            created_dst_se, push_se = self._sparse_created_spread(
+                st, created_l)
+        else:
+            # arrivals via psum, creations via all_gather
+            arrivals_n = lax.psum(mk_se.astype(self._cnt) @ st.a_in_c.T,
+                                  self.axis).astype(_i32)      # [S, N]
+            arrivals_l = self._my_slice(arrivals_n)            # [S, Nl]
+            created_l = (arrivals_l > 0) & ~had_l
+            created_n = lax.all_gather(created_l, self.axis, axis=1,
+                                       tiled=True)             # [S, N]
+            created_f = created_n.astype(self._cnt)
+            created_dst_se = (created_f @ st.a_in_c) > 0.5
+            push_se = (created_f @ st.a_src_c) > 0.5
         stopped = mk_se & s.recording                           # [S, Em]
         started_se = created_dst_se & ~mk_se
         s = s._replace(
@@ -829,7 +1001,6 @@ class GraphShardedRunner:
             has_local=had_l | created_l,
             **window_update(s, started_se, stopped, s.rec_cnt),
         )
-        push_se = (created_f @ st.a_src_c) > 0.5
         s = self._push_markers_split(s, st, push_se)
 
         fire = s.has_local & (s.rem == 0) & ~s.done_local
@@ -851,7 +1022,7 @@ class GraphShardedRunner:
         return s._replace(done_local=s.done_local | fire,
                           completed=completed,
                           snap_done_time=jnp.where(newly, s.time,
-                                                   s.snap_done_time))
+                                                   s.snap_done_time)), erl
 
     # -- program execution -------------------------------------------------
 
@@ -929,13 +1100,17 @@ class GraphShardedRunner:
     def _storm_phase(self, s: ShardedState, st: ShardedTopology,
                      amts, snaps) -> ShardedState:
         """One storm phase: bulk sends + scheduled snapshot initiations +
-        one sync tick (shared by the single-instance and batched bodies)."""
-        s = self._bulk_send(s, st, amts)
+        one sync tick (shared by the single-instance and batched bodies).
+        Local error bits from all three steps fold in ONE boundary psum
+        (was one per error site)."""
+        erl = jnp.int32(0)
+        s, erl = self._bulk_send(s, st, amts, erl)
         init_mask = jnp.any(
             jnp.arange(self.topo.n, dtype=_i32)[None, :]
             == snaps[:, None], axis=0)
         s = self._bulk_snapshots(s, st, init_mask)
-        return self._sync_tick(s, st)
+        s, erl = self._sync_tick(s, st, erl)
+        return self._fold_err(s, erl)
 
     def _drain_flush(self, s: ShardedState, st: ShardedTopology) -> ShardedState:
         """Tick until every started snapshot completes (budgeted), then
@@ -943,29 +1118,61 @@ class GraphShardedRunner:
         on, the replicated error bits halt the instance like completion
         (no ERR_TICK_LIMIT charge for quarantine-denied ticks)."""
         limit = jnp.asarray(s.time + self.config.max_ticks, _i32)
-        if self.quarantine:
-            def cond(s):
-                return (self._pending(s) & (s.time < limit)
-                        & (s.error == 0))
 
-            def flush(s):
-                return lax.cond(s.error == 0,
-                                lambda s: self._sync_tick(s, st),
-                                lambda s: s, s)
-        else:
-            def cond(s):
-                return self._pending(s) & (s.time < limit)
+        def gate(s):
+            g = self._pending(s) & (s.time < limit)
+            if self.quarantine:
+                g = g & (s.error == 0)
+            return g
 
-            def flush(s):
-                return self._sync_tick(s, st)
-        s = lax.while_loop(cond, lambda s: self._sync_tick(s, st), s)
+        def live_anywhere(s):
+            # mesh-global OR of the per-lane gate. In the combined
+            # data x graph mode the lanes drain for different tick counts
+            # (per-lane delay streams), but ppermute — unlike the
+            # subgrouped psum/all_gather — rendezvouses across the WHOLE
+            # device set on the CPU backend, so every device must run the
+            # same number of drain blocks or the sparse engine deadlocks.
+            # Early-finished lanes are frozen by the per-tick gate inside
+            # block() (cond -> select under the lane vmap), so the global
+            # trip count changes no state bit.
+            return lax.psum(gate(s).astype(_i32), self.mesh.axis_names) > 0
+
+        def block(s):
+            # the graphshard MEGATICK: K cond-gated ticks per while body
+            # via an in-shard scan, so host dispatch and the deferred
+            # error fold amortize to the K boundary. Every scanned tick
+            # re-evaluates the live (replicated) drain gate, so K never
+            # overshoots — bit-identical for any K. Under quarantine the
+            # gate reads the replicated error mask, stale by < K ticks
+            # for ERRORING runs only (__init__ docstring).
+            def one(carry, _):
+                return lax.cond(gate(carry[0]),
+                                lambda c: self._sync_tick(c[0], st, c[1]),
+                                lambda c: c, carry), None
+
+            (s, erl), _ = lax.scan(one, (s, jnp.int32(0)), None,
+                                   length=self.megatick)
+            return self._fold_err(s, erl)
+
+        s = lax.while_loop(live_anywhere, block, s)
         budget_blown = self._pending(s)
         if self.quarantine:
             budget_blown = budget_blown & (s.error == 0)
         s = s._replace(error=s.error | jnp.where(
             budget_blown, ERR_TICK_LIMIT, 0).astype(_i32))
-        return lax.fori_loop(0, self.config.max_delay + 1,
-                             lambda _, s: flush(s), s)
+
+        def flush(_, s):
+            erl0 = jnp.int32(0)
+            if self.quarantine:
+                s, erl = lax.cond(
+                    s.error == 0,
+                    lambda c: self._sync_tick(c[0], st, c[1]),
+                    lambda c: c, (s, erl0))
+            else:
+                s, erl = self._sync_tick(s, st, erl0)
+            return self._fold_err(s, erl)
+
+        return lax.fori_loop(0, self.config.max_delay + 1, flush, s)
 
     def _run_script_body(self, s: ShardedState, st: ShardedTopology,
                          script: ShardedScript) -> ShardedState:
@@ -984,23 +1191,30 @@ class GraphShardedRunner:
         def phase(s, xs):
             kind, shard, loc, arg, do_tick = xs
 
-            def op(j, s):
+            def op(j, carry):
+                s, erl = carry
                 send = kind[j] == OP_SEND
-                s = self._inject_send_local(s, st, loc[j], arg[j],
-                                            send & (shard[j] == my))
+                s, erl = self._inject_send_local(s, st, loc[j], arg[j],
+                                                 send & (shard[j] == my),
+                                                 erl)
                 snap_mask = (kind[j] == OP_SNAPSHOT) & (nn == loc[j])
-                return self._bulk_snapshots(s, st, snap_mask)
+                return self._bulk_snapshots(s, st, snap_mask), erl
 
-            s = lax.fori_loop(0, kind.shape[0], op, s)
+            s, erl = lax.fori_loop(0, kind.shape[0], op, (s, jnp.int32(0)))
+            s = self._fold_err(s, erl)
+
             # do_tick is a replicated COUNT (batch.compile_events carries
             # multi-tick stretches as counts now), so the cond branch and
             # its tick loop (which contain collectives) are uniform across
-            # shards
-            return lax.cond(do_tick != 0,
-                            lambda s: lax.fori_loop(
-                                0, do_tick,
-                                lambda _, t: self._sync_tick(t, st), s),
-                            lambda s: s, s), None
+            # shards; per-tick error bits fold once after the stretch
+            def ticks(s):
+                s, erl = lax.fori_loop(
+                    0, do_tick,
+                    lambda _, c: self._sync_tick(c[0], st, c[1]),
+                    (s, jnp.int32(0)))
+                return self._fold_err(s, erl)
+
+            return lax.cond(do_tick != 0, ticks, lambda s: s, s), None
 
         s, _ = lax.scan(phase, s, tuple(script))
         s = self._drain_flush(s, st)
@@ -1098,6 +1312,69 @@ class GraphShardedRunner:
         return jax.tree_util.tree_map(
             lambda x, sp: x[:, None] if sp == sharded else x,
             s, self._state_specs)
+
+    # -- metrics / profiling surfaces --------------------------------------
+
+    def comm_model(self) -> dict:
+        """Analytic per-shard per-tick cross-shard bytes, dense vs sparse
+        (utils/metrics.comm_bytes_model), instantiated with this
+        partition's measured cut (parallel/mesh.boundary_tables)."""
+        from chandy_lamport_tpu.utils.metrics import comm_bytes_model
+
+        return comm_bytes_model(
+            self.topo.n, self.config.max_snapshots, self.shards, self.halo,
+            cut_edges=self._bt.cut_edges, cut_rows=self._bt.cut_rows,
+            count_bytes=jnp.dtype(self._cnt).itemsize)
+
+    def summarize(self, final: ShardedState) -> dict:
+        """Host-side result digest (BatchedRunner.summarize's sharded twin,
+        single instance or a run_storm_batched batch): error decode,
+        snapshot lifecycle counts, and the comm engine's byte model."""
+        from chandy_lamport_tpu.core.state import decode_error_bits
+        from chandy_lamport_tpu.utils.metrics import or_reduce
+
+        h = jax.device_get(final)
+        bits = int(or_reduce(jnp.asarray(h.error).reshape(-1)))
+        started = np.asarray(h.started)
+        completed = np.asarray(h.completed)
+        return {
+            "nodes": self.topo.n,
+            "edges": self.topo.e,
+            "shards": self.shards,
+            "comm_engine": self.comm_engine,
+            "queue_engine": self.queue_engine,
+            "megatick": self.megatick,
+            "total_ticks": int(np.sum(np.asarray(h.time))),
+            "error_bits": bits,
+            "errors_decoded": decode_error_bits(bits),
+            "snapshots_started": int(np.sum(started)),
+            "snapshots_completed": int(
+                np.sum(started & (completed >= self.topo.n))),
+            "comm_bytes_model": self.comm_model(),
+        }
+
+    def jit_tick(self):
+        """The compiled single-sync-tick dispatch (state, stopo_device())
+        -> state — the unit tools/profile_tick.py's "graphshard comm"
+        section times for the dense/sparse A/B. Deferred error bits fold
+        at the tick boundary so the result is a complete, self-consistent
+        state."""
+        if not hasattr(self, "_jit_tick"):
+            from functools import partial
+
+            from chandy_lamport_tpu.utils.shardmap import shard_map
+
+            def body(s, st):
+                s = self._unwrap(s, self._state_specs)
+                st = self._unwrap(st, self._topo_specs)
+                s, erl = self._sync_tick(s, st, jnp.int32(0))
+                return self._wrap(self._fold_err(s, erl), self._state_specs)
+
+            smap = partial(shard_map, mesh=self.mesh)
+            self._jit_tick = jax.jit(smap(
+                body, in_specs=(self._state_specs, self._topo_specs),
+                out_specs=self._state_specs))
+        return self._jit_tick
 
     def gather_dense(self, final: ShardedState):
         """De-shard a finished ShardedState into a host DenseState (global

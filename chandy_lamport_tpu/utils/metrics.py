@@ -228,6 +228,45 @@ def max_batch_estimate(num_nodes: int, num_edges: int, cfg: SimConfig,
     return max(1, int(hbm_bytes / (per * working_set_factor)))
 
 
+def comm_bytes_model(num_nodes: int, max_snapshots: int, shards: int,
+                     halo_rows: int, cut_edges: int | None = None,
+                     cut_rows: int | None = None,
+                     count_bytes: int = 4) -> Dict[str, Any]:
+    """Per-shard, per-tick cross-shard payload bytes for the graph-sharded
+    runner's two comm engines (parallel/graphshard module docstring):
+
+      dense  = 4*N   credit psum (f32 per-node partials)
+             + cb*S*N marker-arrival psum (count dtype, ``count_bytes``)
+             + S*N   created-flags all_gather (bool)
+             + 4*S   finalization psum
+      sparse = (P-1) * ( (S+1)*H*4  forward rows: credit + arrivals, i32
+                       +  S*H       reverse rows: created flags, bool )
+             + 4*S   finalization psum
+
+    where H = ``halo_rows`` (max boundary rows per neighbor pair,
+    parallel/mesh.BoundaryTables) — so sparse scales with the partition
+    CUT while dense scales with N. Error-bit folds are identical on both
+    sides and amortized to phase/megatick boundaries, so they are left
+    out. ``cut_edges``/``cut_rows`` ride along when known (summarize,
+    bench rows)."""
+    n, s, p, h = num_nodes, max_snapshots, shards, halo_rows
+    neighbors = max(p - 1, 0)
+    dense = 4 * n + count_bytes * s * n + s * n + 4 * s
+    sparse = neighbors * ((s + 1) * h * 4 + s * h) + 4 * s
+    out: Dict[str, Any] = {
+        "dense_bytes_per_tick": int(dense),
+        "sparse_bytes_per_tick": int(sparse),
+        "halo_rows": int(h),
+        "neighbors": int(neighbors),
+        "sparse_over_dense": round(sparse / dense, 4) if dense else 0.0,
+    }
+    if cut_edges is not None:
+        out["cut_edges"] = int(cut_edges)
+    if cut_rows is not None:
+        out["cut_rows"] = int(cut_rows)
+    return out
+
+
 def or_reduce(mask) -> jnp.ndarray:
     """Bitwise-OR reduction of an integer bitmask over all axes."""
     mask = jnp.asarray(mask)

@@ -154,6 +154,28 @@ def test_worker_row_round_trips_queue_engine(engine, capsys):
     assert "snapshot_timeout" not in row
 
 
+def test_graphshard_worker_row_round_trips_comm_engine(capsys):
+    """A real (tiny, CPU) graph-sharded --worker run: the row must carry
+    the comm engine and megatick depth that actually ran plus the
+    per-tick comm-bytes model, so a BENCH row measured under the sparse
+    halo exchange can never masquerade as a dense-plane number."""
+    rc = bench.main(["--worker", "--graphshard", "2", "--nodes", "16",
+                     "--phases", "3", "--snapshots", "2", "--repeats", "1",
+                     "--comm-engine", "sparse", "--megatick", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["mode"] == "graphshard" and row["graphshard"] == 2
+    assert row["comm_engine"] == "sparse"
+    assert row["megatick"] == 2
+    model = row["comm_bytes_model"]
+    assert model["sparse_bytes_per_tick"] > 0
+    assert model["dense_bytes_per_tick"] > 0
+    assert model["sparse_over_dense"] == pytest.approx(
+        model["sparse_bytes_per_tick"] / model["dense_bytes_per_tick"],
+        rel=1e-3)
+
+
 @pytest.mark.slow
 def test_worker_row_round_trips_supervisor_knobs(capsys):
     """An armed-supervisor worker run stamps its knobs on the row, so a

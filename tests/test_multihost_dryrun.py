@@ -31,7 +31,14 @@ def test_two_process_loopback_dryrun():
     assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
     verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
     assert verdict["ok"] is True
-    # both workers completed their cross-process-aggregated storms
+    # both workers completed their cross-process-aggregated storms, and
+    # both ran the sparse halo exchange over the fabric (graph-only +
+    # dp x graph) with finals bit-identical to the dense engine
     assert len(verdict["workers"]) == 2
     for w in verdict["workers"]:
-        assert '"global_snapshots_completed": 8' in w
+        row = json.loads(w.splitlines()[-1])
+        assert row["global_snapshots_completed"] == 8
+        assert row["graph_engines_agree"] is True
+        model = row["comm_bytes_model"]
+        assert model["sparse_bytes_per_tick"] > 0
+        assert model["dense_bytes_per_tick"] > model["sparse_bytes_per_tick"]

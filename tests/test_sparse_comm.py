@@ -1,0 +1,244 @@
+"""Sparse halo exchange: boundary-geometry coverage for the comm engines.
+
+The differential suites (test_graphshard.py / test_graphshard_script.py)
+run whatever geometry erdos_renyi and the golden fixtures happen to have;
+these tests pin the corners the sparse engine's boundary tables must get
+right — cut edges in both directions across a shard boundary, a zero-cut
+partition (every ppermute statically elided, halo == 0), single-node
+shards (P == N, the densest possible boundary), and a snapshot whose
+creator's markers must reach edges owned by OTHER shards. Each case
+demands bit-equality with the unsharded sync kernel after gather_dense()
+reassembly, for BOTH engines, so dense stays the executable spec the
+sparse path is checked against. The slow sweep at the bottom replays all
+7 reference goldens through both engines.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import PassTokenEvent, SnapshotEvent, TickEvent
+from chandy_lamport_tpu.models.workloads import (
+    erdos_renyi,
+    staggered_snapshots,
+    storm_program,
+)
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay
+from chandy_lamport_tpu.ops.tick import resolve_comm_engine
+from chandy_lamport_tpu.parallel.batch import BatchedRunner, compile_events
+from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+from chandy_lamport_tpu.utils.fixtures import (
+    TopologySpec,
+    read_events_file,
+    read_topology_file,
+)
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+
+ENGINES = ["sparse", "dense"]
+
+# every differentially-compared DenseState field (the test_graphshard_script
+# list plus the error word)
+FIELDS = ("time", "tokens", "q_meta", "q_data", "q_head", "q_len",
+          "tok_pushed", "mk_cnt", "m_pending", "m_rtime", "m_key",
+          "next_sid", "started", "has_local", "frozen", "rem",
+          "done_local", "recording", "rec_cnt", "min_prot",
+          "log_amt", "rec_start", "rec_end", "completed", "error")
+
+
+def _graph_mesh(p):
+    return Mesh(np.array(jax.devices()[:p]), ("graph",))
+
+
+def _lane0(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[0], tree)
+
+
+def _ref_script(spec, script, cfg, delay=2):
+    ref = BatchedRunner(spec, cfg, FixedJaxDelay(delay), batch=1,
+                        scheduler="sync")
+    return _lane0(jax.device_get(
+        ref.run(ref.init_batch(), compile_events(ref.topo, script))))
+
+
+def _assert_dense_equal(got, want, label=""):
+    for name in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)), np.asarray(getattr(want, name)),
+            err_msg=f"{label}{name}")
+
+
+def _script_case(spec, script, shards, cfg=None, delay=2, **gs_kwargs):
+    """Run a script unsharded and through both engines; demand equality."""
+    cfg = cfg or SimConfig(queue_capacity=16, max_snapshots=8,
+                           max_recorded=16)
+    want = _ref_script(spec, script, cfg, delay=delay)
+    runners = {}
+    for engine in ENGINES:
+        gs = GraphShardedRunner(spec, cfg, _graph_mesh(shards),
+                                fixed_delay=delay, comm_engine=engine,
+                                **gs_kwargs)
+        got = gs.gather_dense(gs.run_script(gs.init_state(), script))
+        _assert_dense_equal(got, want, label=f"{engine}:")
+        runners[engine] = gs
+    return runners
+
+
+def test_cross_boundary_both_directions():
+    """Tokens crossing the 2-shard boundary both ways in the same ticks:
+    shard 0 owns N1/N2, shard 1 owns N3/N4; N1->N3 and N4->N2 are cut
+    edges in opposite directions, so both the forward halo scatter and the
+    reverse flag gather run with real (asymmetric) traffic."""
+    spec = TopologySpec(
+        [("N1", 6), ("N2", 4), ("N3", 5), ("N4", 3)],
+        [("N1", "N3"), ("N4", "N2"), ("N1", "N2"), ("N3", "N4"),
+         ("N2", "N1"), ("N4", "N3")])
+    script = [
+        PassTokenEvent("N1", "N3", 2), PassTokenEvent("N4", "N2", 1),
+        TickEvent(1), SnapshotEvent("N1"),
+        PassTokenEvent("N3", "N4", 1), PassTokenEvent("N2", "N1", 1),
+        TickEvent(4), SnapshotEvent("N4"),
+        PassTokenEvent("N1", "N3", 1), PassTokenEvent("N4", "N2", 2),
+        TickEvent(6),
+    ]
+    runners = _script_case(spec, script, shards=2)
+    assert runners["sparse"].halo > 0
+    model = runners["sparse"].comm_model()
+    assert model["cut_edges"] == 2
+    assert model["sparse_bytes_per_tick"] > 0
+
+
+def test_zero_cut_elides_every_collective():
+    """Two disconnected components, one per shard: no boundary edges, so
+    the sparse engine's halo is 0 and the ppermute loops vanish
+    statically — yet state must still match the unsharded run exactly
+    (including the never-completing foreign-component snapshot rows)."""
+    spec = TopologySpec(
+        [("N1", 5), ("N2", 5), ("N3", 5), ("N4", 5)],
+        [("N1", "N2"), ("N2", "N1"), ("N3", "N4"), ("N4", "N3")])
+    script = [
+        PassTokenEvent("N1", "N2", 2), PassTokenEvent("N3", "N4", 1),
+        TickEvent(1), SnapshotEvent("N1"), SnapshotEvent("N3"),
+        PassTokenEvent("N2", "N1", 1), PassTokenEvent("N4", "N3", 2),
+        TickEvent(5),
+    ]
+    runners = _script_case(spec, script, shards=2)
+    assert runners["sparse"].halo == 0
+    model = runners["sparse"].comm_model()
+    assert model["cut_edges"] == 0
+    # only the replicated scalar reductions remain in the sparse budget
+    assert (model["sparse_bytes_per_tick"]
+            < model["dense_bytes_per_tick"])
+
+
+def test_single_node_shards():
+    """P == N (one node per shard): every edge is a cut edge and every
+    neighbor block is width-1 — the densest boundary the tables express."""
+    n = min(8, len(jax.devices()))
+    if n < 2:
+        pytest.skip("needs >= 2 devices")
+    spec = erdos_renyi(n, 2.5, seed=11, tokens=60)
+    cfg = SimConfig(queue_capacity=16, max_snapshots=8, max_recorded=16)
+    ref = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=1,
+                        scheduler="sync")
+    prog = storm_program(ref.topo, phases=6, amount=1,
+                         snapshot_phases=staggered_snapshots(ref.topo, 2))
+    want = _lane0(jax.device_get(ref.run_storm(ref.init_batch(), prog)))
+    assert int(want.error) == 0
+    for engine in ENGINES:
+        gs = GraphShardedRunner(spec, cfg, _graph_mesh(n), fixed_delay=2,
+                                comm_engine=engine)
+        assert gs.nl == 1
+        got = gs.gather_dense(gs.run_storm(
+            gs.init_state(), np.asarray(prog.amounts),
+            np.asarray(prog.snap)))
+        _assert_dense_equal(got, want, label=f"{engine}:")
+
+
+def test_remote_creator_marker_broadcast():
+    """Snapshot initiated on shard 1 of a cross-shard ring: the creator's
+    marker flags must reach the edges shard 0 owns (the reverse gather +
+    dst_seg flag read), or shard 0 never starts recording for the sid."""
+    spec = TopologySpec(
+        [("N1", 4), ("N2", 4), ("N3", 4), ("N4", 4)],
+        [("N1", "N2"), ("N2", "N3"), ("N3", "N4"), ("N4", "N1")])
+    script = [
+        PassTokenEvent("N1", "N2", 1), TickEvent(1),
+        SnapshotEvent("N3"),           # creator on shard 1
+        PassTokenEvent("N2", "N3", 1), PassTokenEvent("N4", "N1", 1),
+        TickEvent(8),
+    ]
+    runners = _script_case(spec, script, shards=2)
+    gs = runners["sparse"]
+    got = gs.gather_dense(gs.run_script(gs.init_state(), script))
+    assert int(got.completed[0]) == 4      # every node froze for sid 0
+
+
+@pytest.mark.parametrize("megatick", [2, 4])
+def test_megatick_bit_identical(megatick):
+    """K cond-gated ticks per drain dispatch must not change a single
+    state bit relative to K=1, for either engine."""
+    spec = erdos_renyi(16, 2.5, seed=11, tokens=80)
+    cfg = SimConfig(queue_capacity=16, max_snapshots=8, max_recorded=16)
+    gs1 = GraphShardedRunner(spec, cfg, _graph_mesh(4), fixed_delay=2,
+                             comm_engine="sparse", megatick=1)
+    prog = storm_program(gs1.topo, phases=8, amount=1,
+                         snapshot_phases=staggered_snapshots(gs1.topo, 3))
+    want = jax.device_get(gs1.run_storm(
+        gs1.init_state(), np.asarray(prog.amounts), np.asarray(prog.snap)))
+    assert int(want.error) == 0
+    for engine in ENGINES:
+        gsk = GraphShardedRunner(spec, cfg, _graph_mesh(4), fixed_delay=2,
+                                 comm_engine=engine, megatick=megatick)
+        got = jax.device_get(gsk.run_storm(
+            gsk.init_state(), np.asarray(prog.amounts),
+            np.asarray(prog.snap)))
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_comm_engine_knobs():
+    """Config/runner validation and the auto resolution contract."""
+    assert resolve_comm_engine("auto") == "sparse"
+    assert resolve_comm_engine("dense") == "dense"
+    assert resolve_comm_engine("sparse") == "sparse"
+    with pytest.raises(ValueError):
+        resolve_comm_engine("bogus")
+    with pytest.raises(ValueError):
+        SimConfig(comm_engine="bogus")
+    spec = TopologySpec([("N1", 1), ("N2", 1)], [("N1", "N2")])
+    with pytest.raises(ValueError):
+        GraphShardedRunner(spec, SimConfig(), _graph_mesh(2), megatick=0)
+    # SimConfig.comm_engine is the default; the kwarg overrides it
+    gs = GraphShardedRunner(spec, SimConfig(comm_engine="dense"),
+                            _graph_mesh(2), fixed_delay=1)
+    assert gs.comm_engine == "dense"
+    gs = GraphShardedRunner(spec, SimConfig(comm_engine="dense"),
+                            _graph_mesh(2), fixed_delay=1,
+                            comm_engine="sparse")
+    assert gs.comm_engine == "sparse"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("top,events,snaps", REFERENCE_TESTS,
+                         ids=[t[1].removesuffix(".events")
+                              for t in REFERENCE_TESTS])
+@pytest.mark.parametrize("engine", ENGINES)
+def test_goldens_both_engines(top, events, snaps, engine):
+    """All 7 reference goldens, sharded, per engine: bit-equality with the
+    unsharded sync backend (the same contract test_graphshard_script.py
+    pins for the default engine on a subset)."""
+    spec = read_topology_file(fixture_path(top))
+    script = read_events_file(fixture_path(events))
+    n = len(spec.nodes)
+    shards = 2 if n % 2 == 0 else 3
+    if shards > len(jax.devices()):
+        pytest.skip(f"needs {shards} devices")
+    cfg = SimConfig(queue_capacity=32, max_snapshots=16, max_recorded=32)
+    want = _ref_script(spec, script, cfg, delay=2)
+    gs = GraphShardedRunner(spec, cfg, _graph_mesh(shards), fixed_delay=2,
+                            comm_engine=engine)
+    got = gs.gather_dense(gs.run_script(gs.init_state(), script))
+    _assert_dense_equal(got, want, label=f"{engine}:")

@@ -164,6 +164,26 @@ def main() -> None:
           f"phase overhead {(phase - base - send) * 1e3:.2f} ms, "
           f"snapshot-initiation surcharge {(snapped - phase) * 1e3:.2f} ms")
 
+    # graph-sharded comm model at this shape: partition-time boundary
+    # tables give the measured cut, so the dense-vs-sparse byte curves
+    # (utils/metrics.comm_bytes_model) need no mesh or device
+    from chandy_lamport_tpu.parallel.graphshard import shard_topology
+    from chandy_lamport_tpu.utils.metrics import comm_bytes_model
+
+    shard_counts = [p for p in (2, 4, 8) if topo.n % p == 0]
+    if shard_counts:
+        print("\ngraphshard comm model (per-shard bytes/tick, "
+              "dense full-plane vs sparse halo exchange):")
+        for p_ in shard_counts:
+            _, _, bt = shard_topology(runner.topo, p_, incidence=False)
+            m = comm_bytes_model(topo.n, cfg.max_snapshots, p_, bt.halo,
+                                 cut_edges=bt.cut_edges,
+                                 cut_rows=bt.cut_rows)
+            print(f"  P={p_}: dense {m['dense_bytes_per_tick']:>8} B  "
+                  f"sparse {m['sparse_bytes_per_tick']:>8} B  "
+                  f"(ratio {m['sparse_over_dense']:.3f}, "
+                  f"halo {m['halo_rows']}, cut {m['cut_edges']} edges)")
+
 
 if __name__ == "__main__":
     main()

@@ -13,7 +13,13 @@ coordinator, two virtual CPU devices per process, and then
      (a genuine cross-process collective through the distributed runtime);
   4. runs a small batched storm per process and all-reduces the summary
      counters across processes — the exact aggregation path a multi-host
-     1M-instance run uses (parallel/multihost.py module docstring).
+     1M-instance run uses (parallel/multihost.py module docstring);
+  5. runs the graph-sharded runner's sparse halo exchange across the
+     fabric twice — graph-only (the graph axis spanning both processes,
+     boundary ppermutes through the coordinator-connected transport,
+     sparse-vs-dense finals compared by a jitted replicated reduction)
+     and dp x graph on the hybrid mesh — and reports the per-tick
+     comm-bytes model in the worker JSON.
 
 Usage: python tools/multihost_dryrun.py            # parent: spawns 2 workers
        (exit 0 and a one-line JSON verdict on stdout)
@@ -38,9 +44,11 @@ def _child() -> int:
     # jax_platforms programmatically at import time (same workaround as
     # bench.py/conftest.py) — force CPU before the backend initializes
     jax.config.update("jax_platforms", "cpu")
+    from functools import partial
+
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from chandy_lamport_tpu.parallel import multihost
 
@@ -91,7 +99,72 @@ def _child() -> int:
     global_done = int(multihost_utils.process_allgather(done).sum())
     assert global_done == 2 * summary["snapshots_completed"], global_done
 
-    print(json.dumps({"rank": rank, "global_snapshots_completed": global_done}),
+    # sparse halo exchange over the real multi-controller runtime.
+    # (a) graph-only: one giant instance, the graph axis spanning BOTH
+    # processes, so the boundary ppermutes cross the DCN analogue; sparse
+    # (with a megatick-2 drain) and dense finals must agree leaf-for-leaf,
+    # checked by a jitted reduction to a replicated scalar (per-process
+    # device_get of a cross-process-sharded tree is not addressable)
+    from chandy_lamport_tpu.models.workloads import erdos_renyi
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+
+    gmesh = Mesh(np.array(jax.devices()), ("graph",))
+    gspec = erdos_renyi(16, 2.5, seed=13, tokens=40)
+    gcfg = SimConfig(max_snapshots=4)
+    gfinals, comm_model = {}, None
+    for engine in ("sparse", "dense"):
+        gs = GraphShardedRunner(gspec, gcfg, gmesh, seed=3,
+                                comm_engine=engine,
+                                megatick=2 if engine == "sparse" else 1)
+        gprog = storm_program(gs.topo, phases=4, amount=1,
+                              snapshot_phases=staggered_snapshots(gs.topo, 2))
+        gfinals[engine] = gs.run_storm(gs.init_state(),
+                                       np.asarray(gprog.amounts),
+                                       np.asarray(gprog.snap))
+        if engine == "sparse":
+            comm_model = gs.comm_model()
+
+    grep = NamedSharding(gmesh, P())
+
+    @partial(jax.jit, out_shardings=grep)
+    def _agree(a, b):
+        eq = jnp.bool_(True)
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            eq = eq & jnp.all(x == y)
+        return eq
+
+    engines_agree = bool(_agree(gfinals["sparse"], gfinals["dense"]))
+    assert engines_agree, "sparse/dense diverge on the cross-process mesh"
+    gmet = jax.jit(lambda f: jnp.stack([f.error, f.completed[0]]),
+                   out_shardings=grep)(gfinals["sparse"])
+    gerr, gcomp = (int(x) for x in np.asarray(gmet))
+    assert gerr == 0, "graph-only sparse dry run error"
+    assert gcomp == 16, gcomp
+
+    # (b) dp x graph on the hybrid mesh: lanes shard over "data" (across
+    # the processes), each lane's halo exchange rides "graph" (inside one)
+    cspec = erdos_renyi(8, 2.5, seed=21, tokens=40)
+    cgs = GraphShardedRunner(cspec, SimConfig(max_snapshots=4), mesh,
+                             seed=5, comm_engine="sparse")
+    cprog = storm_program(cgs.topo, phases=4, amount=1,
+                          snapshot_phases=staggered_snapshots(cgs.topo, 2))
+    batch = 2 * mesh.shape["data"]
+    cfinal = cgs.run_storm_batched(cgs.init_batch(batch),
+                                   np.asarray(cprog.amounts),
+                                   np.asarray(cprog.snap))
+    cmet = jax.jit(lambda f: jnp.stack([jnp.sum(f.error),
+                                        jnp.sum(f.completed[:, 0])]),
+                   out_shardings=NamedSharding(mesh, P()))(cfinal)
+    cerr, ccomp = (int(x) for x in np.asarray(cmet))
+    assert cerr == 0, "dp x graph sparse dry run error"
+    assert ccomp == batch * cgs.topo.n, (ccomp, batch, cgs.topo.n)
+
+    print(json.dumps({"rank": rank,
+                      "global_snapshots_completed": global_done,
+                      "graph_engines_agree": engines_agree,
+                      "dp_graph_lanes": batch,
+                      "comm_bytes_model": comm_model}),
           flush=True)
     return 0
 

@@ -427,6 +427,60 @@ def main() -> None:
               f"({(t / per_tick - 1) * 100:+6.2f}% vs off)",
               file=sys.stderr)
 
+    # ---- graphshard comm A/B: dense plane vs sparse halo exchange, ------
+    # measured. One sharded sync tick (GraphShardedRunner.jit_tick) at
+    # the gauge shape under comm_engine=dense (full-plane psum/all_gather
+    # + incidence matmuls) and sparse (O(E_local) segment sums + boundary
+    # ppermutes), next to a single-shard mesh (P=1: collectives
+    # degenerate — the collective-formulation floor). Runs on however
+    # many devices are visible (the 8-device CPU mesh under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8); gracefully
+    # skipped when the mesh cannot shard (<2 devices).
+    n_dev = len(jax.devices())
+    gsh = max((k for k in (2, 4, 8)
+               if k <= n_dev and args.nodes % k == 0), default=0)
+    if gsh < 2:
+        print(f"graphshard comm: skipped ({n_dev} device(s) visible; "
+              f"need >=2 dividing --nodes {args.nodes})", file=sys.stderr)
+    else:
+        from jax.sharding import Mesh
+
+        from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+
+        devs = jax.devices()
+        gtimings = {}
+        model = None
+        for gname, shards, engine in (
+                ("dense", gsh, "dense"), ("sparse", gsh, "sparse"),
+                ("single-shard", 1, "sparse")):
+            gmesh = Mesh(np.array(devs[:shards]), ("graph",))
+            gr = GraphShardedRunner(spec, cfg, gmesh, seed=17,
+                                    fixed_delay=2, comm_engine=engine,
+                                    queue_engine=args.queue_engine)
+            if engine == "sparse" and shards == gsh:
+                model = gr.comm_model()
+            gtick = gr.jit_tick()
+            stopo = gr.stopo_device()
+            gs = gtick(gr.init_state(), stopo)     # compile + warm
+            jax.block_until_ready(gs)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                gs = gtick(gs, stopo)
+            jax.block_until_ready(gs)
+            gtimings[gname] = (time.perf_counter() - t0) / reps
+        print(f"graphshard comm (one sharded sync tick, N={args.nodes} "
+              f"P={gsh}):", file=sys.stderr)
+        for gname in ("dense", "sparse", "single-shard"):
+            t = gtimings[gname]
+            print(f"  {gname:<12} {t * 1e3:9.3f} ms/tick "
+                  f"({gtimings['dense'] / t:5.2f}x vs dense)",
+                  file=sys.stderr)
+        print(f"  byte model: dense {model['dense_bytes_per_tick']} B "
+              f"sparse {model['sparse_bytes_per_tick']} B per shard-tick "
+              f"(ratio {model['sparse_over_dense']}, "
+              f"halo {model['halo_rows']} rows x {model['neighbors']} "
+              f"neighbors)", file=sys.stderr)
+
     if args.scheduler == "exact":
         # per-stage wall-clock of the fused exact path: how much of a
         # dispatch is tick-start delivery selection (_select_and_pop, the
