@@ -58,9 +58,20 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 EXIT_BACKEND_INIT = 3  # worker: backend unavailable -> orchestrator retries
+
+# Platforms whose plugins are known-unusable for the bench: experimental
+# device tunnels observed to HANG jax.devices() for the full probe budget
+# rather than fail fast (the BENCH_r05 ladder burned 120s+600s per
+# invocation re-discovering this). When the probe subprocess sees one of
+# these SELECTED, it arms a short watchdog and prints a "dead" verdict
+# instead of letting the orchestrator's timeout expire; the orchestrator
+# records it in the probe-verdict cache and skips straight to the cpu
+# rung (no tpu-blind attempt — the hang is structural, not a flake).
+UNUSABLE_PLATFORMS = ("axon",)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # persistent XLA compilation cache: the bench's dominant warmup cost is the
@@ -145,6 +156,17 @@ def _parser() -> argparse.ArgumentParser:
                         "TPU, mask on CPU where XLA serializes scatters — "
                         "ops/tick.resolve_queue_engine). Bit-identical "
                         "results; the JSON row's queue_engine field "
+                        "records the RESOLVED engine")
+    p.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],
+                   default="auto",
+                   help="tick-kernel engine (chandy_lamport_tpu.kernels): "
+                        "'xla' = the stock-XLA tick formulations, 'pallas' "
+                        "= the fused Pallas ring-queue + segment-reduction "
+                        "kernels (interpret-mode emulation off-TPU), 'auto' "
+                        "(default) = pallas only where compiled Pallas "
+                        "exists (TPU), xla elsewhere with a logged reason "
+                        "(kernels.resolve_kernel_engine). Bit-identical "
+                        "results; the JSON row's kernel_engine field "
                         "records the RESOLVED engine")
     p.add_argument("--comm-engine", choices=["auto", "dense", "sparse"],
                    default="auto",
@@ -279,6 +301,31 @@ def run_probe() -> int:
         jax.config.update("jax_platforms", "")
     elif platform:
         jax.config.update("jax_platforms", platform)
+    # known-unusable platform fail-fast: the plugin may have selected
+    # itself programmatically at import time (jax_platforms is set by the
+    # time we read it), and its jax.devices() HANGS rather than failing —
+    # arm a watchdog that declares the platform dead well inside the
+    # orchestrator's probe timeout, so the ladder learns the verdict in
+    # ~20s instead of burning the 120s probe + 600s tpu-blind budgets
+    selected = (jax.config.jax_platforms or "").split(",")[0].strip().lower()
+    watchdog = None
+    if selected in UNUSABLE_PLATFORMS:
+        deadline = float(os.environ.get("CLSIM_PROBE_DEADLINE", "20"))
+
+        def _declare_dead():
+            print(json.dumps({
+                "probe": "dead", "platform": selected,
+                "reason": f"experimental platform {selected!r} selected and "
+                          f"unresponsive for {deadline:.0f}s (known to hang "
+                          "jax.devices() rather than fail fast)"}),
+                flush=True)
+            os._exit(0)
+
+        log(f"probe: known-unusable platform {selected!r} selected; "
+            f"arming {deadline:.0f}s watchdog")
+        watchdog = threading.Timer(deadline, _declare_dead)
+        watchdog.daemon = True
+        watchdog.start()
     try:
         dev = jax.devices()[0]
         _enable_compile_cache(dev.platform)
@@ -289,6 +336,9 @@ def run_probe() -> int:
     except Exception as exc:
         log(f"probe failed: {type(exc).__name__}: {exc}")
         return EXIT_BACKEND_INIT
+    finally:
+        if watchdog is not None:
+            watchdog.cancel()
     print(json.dumps({"probe": "ok", "platform": dev.platform,
                       "device_kind": dev.device_kind}), flush=True)
     return 0
@@ -440,7 +490,8 @@ def run_worker(args) -> int:
                                exact_impl=args.exact_impl,
                                auto_layouts=args.layouts == "auto",
                                megatick=args.megatick,
-                               queue_engine=args.queue_engine, trace=trace)
+                               queue_engine=args.queue_engine,
+                               kernel_engine=args.kernel_engine, trace=trace)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -560,7 +611,8 @@ def run_worker(args) -> int:
                              exact_impl=args.exact_impl,
                              auto_layouts=args.layouts == "auto",
                              megatick=args.megatick,
-                             queue_engine=args.queue_engine)
+                             queue_engine=args.queue_engine,
+                             kernel_engine=args.kernel_engine)
         fmtb = base.prepare_storm(prog)
         fb = base.run_storm(base.init_batch_device(formats=fmtb), prog)
         jax.block_until_ready(fb)
@@ -597,6 +649,7 @@ def run_worker(args) -> int:
                       else f"exact/{args.exact_impl}"),
         **({"megatick": args.megatick} if args.scheduler == "exact" else {}),
         "queue_engine": runner.queue_engine,
+        "kernel_engine": runner.kernel_engine,
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -717,7 +770,8 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            megatick=args.megatick,
-                           queue_engine=args.queue_engine, trace=trace)
+                           queue_engine=args.queue_engine,
+                           kernel_engine=args.kernel_engine, trace=trace)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
                        tail_alpha=1.1, max_phases=max(args.phases, 8))
@@ -777,6 +831,7 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
         "scheduler": (args.scheduler if args.scheduler == "sync"
                       else f"exact/{args.exact_impl}"),
         "queue_engine": runner.queue_engine,
+        "kernel_engine": runner.kernel_engine,
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -848,6 +903,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
     runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
                                 queue_engine=args.queue_engine,
                                 comm_engine=args.comm_engine,
+                                kernel_engine=args.kernel_engine,
                                 megatick=args.megatick)
     topo = runner.topo
     log(f"graphshard: {topo.n} nodes / {args.graphshard} shards "
@@ -888,6 +944,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         runner = GraphShardedRunner(spec, cfg, mesh, seed=17,
                                     queue_engine=args.queue_engine,
                                     comm_engine=args.comm_engine,
+                                    kernel_engine=args.kernel_engine,
                                     megatick=args.megatick)
 
     times, ticks_seen = [], []
@@ -923,6 +980,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "device_kind": dev.device_kind,
         "scheduler": "sync",
         "queue_engine": runner.queue_engine,
+        "kernel_engine": runner.kernel_engine,
         "comm_engine": runner.comm_engine,
         "megatick": runner.megatick,
         # analytic per-shard per-tick bytes for both engines at THIS
@@ -1004,7 +1062,9 @@ def _spawn(name, mode, env_overrides, extra, timeout, argv):
 
 def _load_probe_cache(ttl: float):
     """The cached probe verdict, or None when absent/stale/unreadable.
-    Entries: {"platform": str|None, "env": {...}, "ts": unix-seconds}."""
+    Entries: {"platform": str|None, "env": {...}, "ts": unix-seconds,
+    "dead_platform": str|None} — ``dead_platform`` names a known-unusable
+    platform the probe watchdog declared dead (UNUSABLE_PLATFORMS)."""
     try:
         with open(PROBE_CACHE_PATH) as f:
             data = json.load(f)
@@ -1017,7 +1077,7 @@ def _load_probe_cache(ttl: float):
         return None
 
 
-def _store_probe_cache(platform, env) -> None:
+def _store_probe_cache(platform, env, dead_platform=None) -> None:
     """Record the ladder's verdict (atomic tmp + os.replace; best-effort —
     the cache is an optimization, never a failure)."""
     try:
@@ -1025,6 +1085,7 @@ def _store_probe_cache(platform, env) -> None:
         tmp = PROBE_CACHE_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"platform": platform, "env": env,
+                       "dead_platform": dead_platform,
                        "ts": time.time()}, f)
         os.replace(tmp, PROBE_CACHE_PATH)
     except OSError as exc:
@@ -1033,9 +1094,12 @@ def _store_probe_cache(platform, env) -> None:
 
 def _find_live_platform(args):
     """Liveness probe ladder. Returns (platform|None, env_overrides,
-    recently_dead) — ``recently_dead`` is True when a fresh cached verdict
-    already said the tunnel was down (main() shrinks the tpu-blind budget
-    on its strength).
+    recently_dead, dead_platform) — ``recently_dead`` is True when a fresh
+    cached verdict already said the tunnel was down (main() shrinks the
+    tpu-blind budget on its strength); ``dead_platform`` names a
+    known-unusable platform the probe watchdog declared dead
+    (UNUSABLE_PLATFORMS — main() then skips tpu-blind outright, since the
+    hang is structural, and falls straight to the cpu rung).
 
     The TPU plugin has been observed to HANG in jax.devices() (not just
     fail fast) when the device tunnel is down — and transient tunnel flakes
@@ -1043,15 +1107,33 @@ def _find_live_platform(args):
     jax's automatic platform choice (covers the round-1 plugin-init
     failure, where JAX_PLATFORMS='' would have worked). The verdict is
     cached (PROBE_CACHE_PATH): within --probe-cache-ttl a live verdict
-    skips the ladder entirely, and a dead verdict caps each probe at 30s —
-    re-discovering the same dead tunnel cost the round-5 bench >12 minutes
-    per invocation."""
+    skips the ladder entirely, a dead-PLATFORM verdict short-circuits with
+    zero probe subprocesses, and a generic dead verdict caps each probe at
+    30s — re-discovering the same dead tunnel cost the round-5 bench >12
+    minutes per invocation."""
+
+    def _dead_verdict(probe, env):
+        """A watchdog 'dead' line from any probe leg ends the ladder:
+        retrying or asking jax's auto choice re-selects the same plugin
+        and hangs identically."""
+        dead = probe.get("platform") or "?"
+        log(f"probe declared platform {dead!r} unusable: "
+            f"{probe.get('reason')}")
+        if not args.no_probe_cache:
+            _store_probe_cache(None, env, dead_platform=dead)
+        return None, {}, True, dead
+
     cached = None if args.no_probe_cache \
         else _load_probe_cache(args.probe_cache_ttl)
     if cached is not None and cached.get("platform"):
         log(f"probe verdict reused from cache ({cached['age']:.0f}s old): "
             f"platform={cached['platform']}")
-        return cached["platform"], dict(cached.get("env") or {}), False
+        return cached["platform"], dict(cached.get("env") or {}), False, None
+    if cached is not None and cached.get("dead_platform"):
+        log(f"probe verdict reused from cache ({cached['age']:.0f}s old): "
+            f"platform {cached['dead_platform']!r} is unusable — skipping "
+            "the probe ladder entirely")
+        return None, {}, True, cached["dead_platform"]
     recently_dead = cached is not None
     probe_timeout = args.probe_timeout
     if recently_dead:
@@ -1060,23 +1142,29 @@ def _find_live_platform(args):
             f"answered; re-checking with {probe_timeout:.0f}s probes")
     probe, timed_out, _, _ = _spawn("probe", "--probe", {}, [],
                                  probe_timeout, [])
+    if probe is not None and probe.get("probe") == "dead":
+        return _dead_verdict(probe, {})
     if probe is None and timed_out and not recently_dead:
         probe, timed_out, _, _ = _spawn("probe-retry", "--probe", {}, [],
                                      probe_timeout, [])
+        if probe is not None and probe.get("probe") == "dead":
+            return _dead_verdict(probe, {})
     if probe is not None:
         if not args.no_probe_cache:
             _store_probe_cache(probe.get("platform"), {})
-        return probe.get("platform"), {}, recently_dead
+        return probe.get("platform"), {}, recently_dead, None
     auto_env = {"CLSIM_PLATFORM": "auto"}
     probe, _, _, _ = _spawn("probe-auto", "--probe", auto_env, [],
                          probe_timeout, [])
+    if probe is not None and probe.get("probe") == "dead":
+        return _dead_verdict(probe, auto_env)
     if probe is not None:
         if not args.no_probe_cache:
             _store_probe_cache(probe.get("platform"), auto_env)
-        return probe.get("platform"), auto_env, recently_dead
+        return probe.get("platform"), auto_env, recently_dead, None
     if not args.no_probe_cache:
         _store_probe_cache(None, {})
-    return None, {}, recently_dead
+    return None, {}, recently_dead, None
 
 
 def main(argv=None) -> int:
@@ -1089,12 +1177,13 @@ def main(argv=None) -> int:
 
     argv = [a for a in argv if a not in ("--worker", "--probe",
                                          "--assume-tpu")]
-    recently_dead = False
+    recently_dead, dead_platform = False, None
     if args.assume_tpu:
         platform, env = "tpu", {}
         log("probe skipped (--assume-tpu): caller vouches for the tunnel")
     else:
-        platform, env, recently_dead = _find_live_platform(args)
+        platform, env, recently_dead, dead_platform = \
+            _find_live_platform(args)
         log(f"probe verdict: platform={platform}")
 
     plan = []
@@ -1133,6 +1222,14 @@ def main(argv=None) -> int:
         # CLSIM_PLATFORM=cpu run — the probe inherits it) still gets the
         # full-size attempt before any clamped fallback
         plan.append(("default", env, [], args.timeout, None))
+    elif dead_platform is not None:
+        # the probe watchdog positively identified a known-unusable
+        # platform (UNUSABLE_PLATFORMS) — its hang is structural, not a
+        # tunnel flake, so a blind full-size attempt would burn its whole
+        # budget discovering the same thing; fall straight to the cpu rung
+        log(f"skipping tpu-blind: platform {dead_platform!r} is "
+            "known-unusable (probe watchdog verdict) — falling straight "
+            "to the cpu rung")
     else:
         # every probe hung: the tunnel may still recover mid-window (hung
         # device calls complete when it does), so spend one full-size

@@ -205,7 +205,8 @@ def _cmd_storm(args) -> int:
                            batch=args.batch, scheduler=args.scheduler,
                            exact_impl=args.exact_impl,
                            check_every=args.check_every,
-                           megatick=args.megatick, faults=faults,
+                           megatick=args.megatick,
+                           kernel_engine=args.kernel_engine, faults=faults,
                            quarantine=quarantine, trace=trace)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
@@ -348,6 +349,7 @@ def _cmd_stream(args) -> int:
         trace = JaxTrace(capacity=args.trace_capacity)
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
+                           kernel_engine=args.kernel_engine,
                            faults=faults, quarantine=faults is not None,
                            trace=trace)
     jcount = args.jobs or 3 * args.batch
@@ -499,6 +501,13 @@ def main(argv=None) -> int:
                     default="int32")
     ps.add_argument("--reduce-mode", choices=["auto", "matmul", "segsum"],
                     default="auto")
+    ps.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],
+                    default="auto",
+                    help="tick-kernel engine (chandy_lamport_tpu.kernels): "
+                         "'pallas' = the fused ring-queue + segment-"
+                         "reduction kernels (interpret-mode emulation off-"
+                         "TPU), 'auto' = pallas only on TPU; bit-identical "
+                         "results")
     ps.add_argument("--check-every", type=int, default=0,
                     help="evaluate the token-conservation invariant inside "
                          "the run every K phases (0 = off); violations set "
@@ -603,6 +612,10 @@ def main(argv=None) -> int:
     pq.add_argument("--max-phases", type=int, default=32)
     pq.add_argument("--snapshots", type=int, default=8)
     pq.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    pq.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],
+                    default="auto",
+                    help="tick-kernel engine (chandy_lamport_tpu.kernels); "
+                         "bit-identical results")
     pq.add_argument("--seed", type=int, default=0)
     pq.add_argument("--delay", choices=["uniform", "hash"], default="hash")
     pq.add_argument("--admission", choices=["stream", "gang"],

@@ -79,6 +79,16 @@ class SimConfig:
     # engines are bit-identical to the unsharded sync kernel; a runner
     # kwarg overrides this per-instance.
     comm_engine: str = "auto"
+    # Tick-kernel engine (chandy_lamport_tpu/kernels): "xla" keeps the
+    # stock-XLA tick formulations; "pallas" routes the ring-queue
+    # head/select/pop/append chain and the edge->node segment reductions
+    # through the hand-fused Pallas kernels (interpret-mode emulation
+    # off-TPU, so CI exercises the kernel bodies everywhere); "auto"
+    # resolves to "pallas" only where compiled Pallas is supported (TPU),
+    # "xla" elsewhere with a logged reason (kernels.resolve_kernel_engine).
+    # Bit-identical results either way; runner kwargs override this
+    # per-instance.
+    kernel_engine: str = "auto"
     # Snapshot supervisor (ops/tick.TickKernel._supervise): with
     # snapshot_timeout > 0, a started snapshot that has not completed
     # within that many ticks of its (re-)initiation is aborted IN TRACE —
@@ -130,6 +140,9 @@ class SimConfig:
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
         if self.comm_engine not in ("auto", "dense", "sparse"):
             raise ValueError("comm_engine must be 'auto', 'dense' or 'sparse'")
+        if self.kernel_engine not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                "kernel_engine must be 'auto', 'xla' or 'pallas'")
         if (self.snapshot_timeout < 0 or self.snapshot_retries < 0
                 or self.snapshot_every < 0):
             raise ValueError(

@@ -85,7 +85,9 @@ class DenseSim:
                  delay_model: Union[DelayModel, JaxDelay],
                  config: Optional[SimConfig] = None,
                  exact_impl: str = "cascade", megatick: int = 8,
-                 queue_engine: str = "auto", faults=None, trace=None):
+                 queue_engine: str = "auto",
+                 kernel_engine: Optional[str] = None, faults=None,
+                 trace=None):
         """``megatick``: K-tick fusion depth for ``tick N`` events and the
         drain loop (ops/tick.TickKernel docstring); semantics-preserving,
         1 restores the reference-literal one-iteration-per-tick loops (the
@@ -93,6 +95,9 @@ class DenseSim:
         ``queue_engine``: ring-queue addressing (TickKernel docstring) —
         "gather" O(E) gathers/scatters, "mask" one-hot, or "auto"
         (default, backend-resolved); bit-identical results.
+        ``kernel_engine``: tick-kernel engine ("xla" / "pallas" / "auto",
+        chandy_lamport_tpu.kernels) — None (default) defers to the
+        config's knob; bit-identical results.
         ``faults``: models/faults.JaxFaults or None — arm the deterministic
         fault adversary (TickKernel docstring); None compiles the hooks
         away entirely.
@@ -117,8 +122,10 @@ class DenseSim:
                 or JaxTrace.DEFAULT_CAPACITY)
         self.kernel = TickKernel(self.topo, self.config, self.delay,
                                  exact_impl=exact_impl, megatick=megatick,
-                                 queue_engine=queue_engine, faults=faults,
+                                 queue_engine=queue_engine,
+                                 kernel_engine=kernel_engine, faults=faults,
                                  trace=trace)
+        self.kernel_engine = self.kernel.kernel_engine
         # same surface as ParitySim: ``sim.trace`` is the timeline view
         # when armed, None otherwise
         self.trace = DenseTraceView(self) if self.kernel._trace_on else None

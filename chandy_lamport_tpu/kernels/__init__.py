@@ -1,0 +1,103 @@
+"""Pallas tick kernels: fused ring-queue ops + edge->node reductions.
+
+The tick inner loop's hot ops — the ring-queue head-read -> route -> pop ->
+append chain and the CSR-ordered edge->node segment reductions — as
+hand-written Pallas kernels, selectable per kernel via
+``SimConfig.kernel_engine`` (plumbed through TickKernel / DenseSim /
+BatchedRunner / GraphShardedRunner / bench / CLI, the queue_engine /
+comm_engine knob pattern):
+
+  "xla"    — the stock-XLA formulations (ops/tick.py), unchanged;
+  "pallas" — the kernels in this package;
+  "auto"   — "pallas" only where COMPILED Pallas is supported (TPU),
+             "xla" everywhere else with a logged reason
+             (resolve_kernel_engine below).
+
+Off-TPU the kernels still run — under ``interpret=True`` emulation — so
+tier-1 CI exercises the exact kernel bodies on the CPU mesh and the
+bit-identity bar (tests/test_pallas_kernels.py) is enforced everywhere,
+while ``auto`` never selects the (slow) emulation for production runs.
+
+Block shapes and the VMEM budget
+--------------------------------
+Every kernel here is a single-program ``pl.pallas_call`` whose operands are
+whole-array VMEM blocks (no grid): the packed ring planes ``q_meta`` /
+``q_data`` are ``[E, C]`` i32, everything else is ``[E]`` / ``[N]`` vectors,
+so one fused queue step touches ``4*E*C + ~6*E`` 4-byte words of VMEM —
+about 0.8 MB at the bench shape (E~2k, C=24) and ~6.5 MB at the 8k-node
+ladder config (E~16k), inside the ~16 MB/core budget
+(``pltpu.CompilerParams(vmem_limit_bytes=...)`` is left at its default).
+The win over the stock-XLA path is not the arithmetic — it is that the
+head gather, eligibility test, per-source prefix-count selection and pop
+read the ``[E, C]`` planes ONCE from HBM and keep every intermediate
+(one-hot hit masks, cumsums, selection masks) VMEM-resident, where XLA
+materializes them as separate HBM-level tensors between fusions. Shapes
+past ``E*C ~ 4M`` words need a real edge-blocked grid (the CSR layout's
+``dst_lo/dst_hi`` bounds are the natural block boundaries) — future work,
+called out here so ``auto`` can gate on footprint when it lands.
+
+Inside the kernel bodies only TPU-lowerable jnp ops are used for the
+``[E, C]`` work (``broadcasted_iota`` one-hot selects, ``cumsum``,
+``where`` — no scatter); the segment kernels use the same exclusive
+prefix-sum + bounds-take formulation as the XLA segsum path, so
+bit-identity with the XLA engine is by construction, not by accident.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+KERNEL_ENGINES = ("auto", "xla", "pallas")
+
+
+def resolve_kernel_engine(engine: str, backend: str | None = None) -> str:
+    """Resolve the tick-kernel engine knob (SimConfig.kernel_engine):
+    "auto" picks "pallas" only where compiled Pallas is supported — TPU —
+    and falls back to "xla" with a logged reason everywhere else (Pallas
+    runs off-TPU only as interpret-mode emulation, orders of magnitude
+    slower than XLA's native lowering, so auto must never select it for a
+    production run; an explicit "pallas" still gets the emulated kernels,
+    which is how CI pins bit-identity from the CPU mesh). ``backend``
+    defaults to the live jax backend; parameterized so CI can pin the TPU
+    decision from the CPU mesh (the resolve_queue_engine pattern)."""
+    if engine not in KERNEL_ENGINES:
+        raise ValueError(f"unknown kernel_engine {engine!r}")
+    if engine != "auto":
+        return engine
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    if backend == "tpu":
+        return "pallas"
+    logger.info(
+        "kernel_engine='auto' resolved to 'xla': backend %r has no compiled "
+        "Pallas support (Pallas would run as interpret-mode emulation; pass "
+        "kernel_engine='pallas' explicitly to exercise the kernels anyway)",
+        backend)
+    return "xla"
+
+
+def pallas_interpret(backend: str | None = None) -> bool:
+    """Whether Pallas kernels must run under ``interpret=True`` here:
+    everywhere except TPU (the only backend with compiled Pallas support
+    in this image). One definition, so every caller builds kernels for
+    the same regime the resolver assumed."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return backend != "tpu"
+
+
+from chandy_lamport_tpu.kernels import queue, segment  # noqa: E402
+
+__all__ = [
+    "KERNEL_ENGINES",
+    "pallas_interpret",
+    "queue",
+    "resolve_kernel_engine",
+    "segment",
+]

@@ -1,0 +1,177 @@
+"""Fused Pallas ring-queue kernels (package docstring: block shapes/VMEM).
+
+Three entry points mirroring ops/tick.TickKernel's queue primitives —
+``head_fields`` (head gather + meta decode), ``queue_step`` (the fully
+fused head-read -> eligibility -> per-source selection -> pop used by the
+fault-free exact tick), ``select_pop`` (selection + pop over an externally
+gated eligibility mask, the fault-adversary path), and ``append_rows``
+(the batched routed append with overflow flagging). All are bit-identical
+to the XLA formulations by construction: same one-hot/prefix-sum math,
+same error-bit reductions, just VMEM-resident between the pieces.
+
+Inside the kernels the ``[E, C]`` planes are addressed with
+``broadcasted_iota`` one-hot masks (TPU has no in-kernel scatter; a
+VMEM-resident one-hot select costs no HBM traffic, which is what made the
+mask engine lose at the XLA level). Every scalar operand rides in as a
+``(1,)`` array (TPU scalars must be >= 1-D).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from chandy_lamport_tpu.core.state import (
+    ERR_QUEUE_OVERFLOW,
+    ERR_VALUE_OVERFLOW,
+    RTIME_PACK_LIMIT,
+    meta_marker,
+    meta_rtime,
+)
+
+_i32 = jnp.int32
+
+
+def _head_one_hot(q_meta, q_data, q_head):
+    """VMEM one-hot head gather: (head_meta, head_data), both [E] i32."""
+    cc = jax.lax.broadcasted_iota(_i32, q_meta.shape, q_meta.ndim - 1)
+    hit = cc == q_head[..., None]
+    head_meta = jnp.sum(jnp.where(hit, q_meta, 0), axis=-1, dtype=_i32)
+    head_data = jnp.sum(jnp.where(hit, q_data, 0), axis=-1, dtype=_i32)
+    return head_meta, head_data
+
+
+def _select(elig, src_first):
+    """First eligible edge per source, in dest order: the O(E) exclusive
+    prefix-count formulation (edges are per-source contiguous)."""
+    elig_i = elig.astype(_i32)
+    before = jnp.cumsum(elig_i, axis=-1) - elig_i
+    return elig & (before == jnp.take(before, src_first, axis=-1))
+
+
+def _head_fields_kernel(qm_ref, qd_ref, qh_ref, rt_ref, mk_ref, data_ref):
+    head_meta, head_data = _head_one_hot(qm_ref[...], qd_ref[...],
+                                         qh_ref[...])
+    rt_ref[...] = meta_rtime(head_meta)
+    mk_ref[...] = meta_marker(head_meta)
+    data_ref[...] = head_data
+
+
+def head_fields(q_meta, q_data, q_head, *, interpret: bool):
+    """Every ring head's (rtime, is_marker, data) — TickKernel._head_fields
+    as one fused VMEM pass over the packed planes."""
+    e = q_head.shape[-1]
+    return pl.pallas_call(
+        _head_fields_kernel,
+        out_shape=(jax.ShapeDtypeStruct((e,), _i32),
+                   jax.ShapeDtypeStruct((e,), jnp.bool_),
+                   jax.ShapeDtypeStruct((e,), _i32)),
+        interpret=interpret,
+    )(q_meta, q_data, q_head)
+
+
+def _queue_step_kernel(qm_ref, qd_ref, qh_ref, ql_ref, t_ref, sf_ref,
+                       tok_ref, mk_ref, data_ref, nh_ref, nl_ref,
+                       *, capacity: int):
+    ql = ql_ref[...]
+    qh = qh_ref[...]
+    head_meta, head_data = _head_one_hot(qm_ref[...], qd_ref[...], qh)
+    head_mk = meta_marker(head_meta)
+    elig = (ql > 0) & (meta_rtime(head_meta) <= t_ref[0])
+    sel = _select(elig, sf_ref[...])
+    tok_ref[...] = sel & ~head_mk
+    mk_ref[...] = sel & head_mk
+    data_ref[...] = head_data
+    nh_ref[...] = (qh + sel) % capacity
+    nl_ref[...] = ql - sel.astype(_i32)
+
+
+def queue_step(q_meta, q_data, q_head, q_len, time, src_first,
+               *, capacity: int, interpret: bool):
+    """THE fused queue step (fault-free _select_and_pop): head gather +
+    meta decode + eligibility + per-source prefix-count selection + pop in
+    ONE pass over the packed [E, C] planes. Returns (tok_pend, mk_pend,
+    head_data, new_q_head, new_q_len)."""
+    e = q_head.shape[-1]
+    return pl.pallas_call(
+        functools.partial(_queue_step_kernel, capacity=capacity),
+        out_shape=(jax.ShapeDtypeStruct((e,), jnp.bool_),
+                   jax.ShapeDtypeStruct((e,), jnp.bool_),
+                   jax.ShapeDtypeStruct((e,), _i32),
+                   jax.ShapeDtypeStruct((e,), _i32),
+                   jax.ShapeDtypeStruct((e,), _i32)),
+        interpret=interpret,
+    )(q_meta, q_data, q_head, q_len, jnp.reshape(time, (1,)).astype(_i32),
+      src_first)
+
+
+def _select_pop_kernel(qh_ref, ql_ref, elig_ref, sf_ref,
+                       sel_ref, nh_ref, nl_ref, *, capacity: int):
+    sel = _select(elig_ref[...], sf_ref[...])
+    sel_ref[...] = sel
+    nh_ref[...] = (qh_ref[...] + sel) % capacity
+    nl_ref[...] = ql_ref[...] - sel.astype(_i32)
+
+
+def select_pop(q_head, q_len, elig, src_first, *, capacity: int,
+               interpret: bool):
+    """Selection + pop over an externally gated eligibility mask (the
+    fault-adversary path, where jitter/crash gates edit ``elig`` between
+    the head read and the selection). Returns (sel, new_q_head,
+    new_q_len)."""
+    e = q_head.shape[-1]
+    return pl.pallas_call(
+        functools.partial(_select_pop_kernel, capacity=capacity),
+        out_shape=(jax.ShapeDtypeStruct((e,), jnp.bool_),
+                   jax.ShapeDtypeStruct((e,), _i32),
+                   jax.ShapeDtypeStruct((e,), _i32)),
+        interpret=interpret,
+    )(q_head, q_len, elig, src_first)
+
+
+def _append_rows_kernel(qm_ref, qd_ref, qh_ref, ql_ref, tp_ref, act_ref,
+                        meta_ref, rt_ref, data_ref,
+                        om_ref, od_ref, err_ref,
+                        *, capacity: int, key_limit: int,
+                        flag_queue_overflow: bool):
+    qm = qm_ref[...]
+    active = act_ref[...]
+    ql = ql_ref[...]
+    err = (jnp.any(active & (tp_ref[...] >= key_limit))
+           | jnp.any(active & (rt_ref[...] >= RTIME_PACK_LIMIT))
+           ).astype(_i32) * ERR_VALUE_OVERFLOW
+    if flag_queue_overflow:
+        err = err | (jnp.any(active & (ql >= capacity)).astype(_i32)
+                     * ERR_QUEUE_OVERFLOW)
+    pos = (qh_ref[...] + ql) % capacity
+    cc = jax.lax.broadcasted_iota(_i32, qm.shape, qm.ndim - 1)
+    hit = active[..., None] & (cc == pos[..., None])
+    om_ref[...] = jnp.where(hit, meta_ref[...][..., None], qm)
+    od_ref[...] = jnp.where(hit, data_ref[...][..., None], qd_ref[...])
+    err_ref[...] = jnp.reshape(err, (1,))
+
+
+def append_rows(q_meta, q_data, q_head, q_len, tok_pushed, active,
+                meta_e, rt_e, data_e, *, capacity: int, key_limit: int,
+                flag_queue_overflow: bool = True, interpret: bool):
+    """The batched routed ring append (TickKernel._append_rows /
+    GraphShardedRunner._append_active): one fused pass computing the tail
+    positions, the one-hot routed writes of BOTH packed planes, and the
+    overflow error bits (queue overflow gated off for the sharded twin,
+    which books it elsewhere). ``meta_e`` is the pre-packed slot word
+    (state.pack_meta), ``rt_e`` the raw receive times for the
+    RTIME_PACK_LIMIT check. Returns (q_meta', q_data', err_bits[1]);
+    the q_len/tok_pushed advances are elementwise adds the caller keeps."""
+    return pl.pallas_call(
+        functools.partial(_append_rows_kernel, capacity=capacity,
+                          key_limit=key_limit,
+                          flag_queue_overflow=flag_queue_overflow),
+        out_shape=(jax.ShapeDtypeStruct(q_meta.shape, q_meta.dtype),
+                   jax.ShapeDtypeStruct(q_data.shape, q_data.dtype),
+                   jax.ShapeDtypeStruct((1,), _i32)),
+        interpret=interpret,
+    )(q_meta, q_data, q_head, q_len, tok_pushed, active, meta_e, rt_e,
+      data_e)

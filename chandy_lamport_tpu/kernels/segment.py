@@ -1,0 +1,81 @@
+"""Pallas edge->node segment reductions over the CSR-style sorted-edge
+layout (package docstring: block shapes/VMEM).
+
+The XLA segsum formulation (ops/tick.TickKernel._segment_sums) is an
+exclusive prefix sum plus two bounds-takes; these kernels keep exactly
+that math — so bit-identity with the XLA engine is by construction — but
+fuse the by-destination permutation gather, the cumsum and the bounds
+gathers into one VMEM-resident pass instead of three HBM-level tensors.
+``spread`` is the inverse direction (node flag -> incident edges), one
+fused gather. Operands may carry leading batch axes (the [S, E] snapshot
+planes); all work is along the trailing axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_i32 = jnp.int32
+
+
+def _cast(x):
+    """Match XLA's cumsum dtype promotion (bool -> i32) so out_shape and
+    the kernel body agree with the stock path bit-for-bit."""
+    return x.astype(_i32) if x.dtype == jnp.bool_ else x
+
+
+def _bounded_sums(xs, lo, hi):
+    cs = jnp.cumsum(xs, axis=-1)
+    cs0 = jnp.concatenate([jnp.zeros_like(cs[..., :1]), cs], axis=-1)
+    return jnp.take(cs0, hi, axis=-1) - jnp.take(cs0, lo, axis=-1)
+
+
+def _sum_segments_kernel(xs_ref, lo_ref, hi_ref, out_ref):
+    out_ref[...] = _bounded_sums(xs_ref[...], lo_ref[...], hi_ref[...])
+
+
+def sum_segments(xs, lo, hi, *, interpret: bool):
+    """[..., E] -> [..., N] per-segment sums; ``xs`` already in segment
+    order (the _sum_by_src case: edges are src-sorted as laid out)."""
+    xs = _cast(xs)
+    return pl.pallas_call(
+        _sum_segments_kernel,
+        out_shape=jax.ShapeDtypeStruct(xs.shape[:-1] + lo.shape, xs.dtype),
+        interpret=interpret,
+    )(xs, lo, hi)
+
+
+def _sum_by_perm_kernel(x_ref, perm_ref, lo_ref, hi_ref, out_ref):
+    xs = jnp.take(x_ref[...], perm_ref[...], axis=-1)
+    out_ref[...] = _bounded_sums(xs, lo_ref[...], hi_ref[...])
+
+
+def sum_by_perm(x_e, perm, lo, hi, *, interpret: bool):
+    """[..., E] -> [..., N]: permute into segment order (``by_dst``) then
+    segment-sum, fused — the _sum_by_dst case (token credits, marker
+    arrival counts)."""
+    x_e = _cast(x_e)
+    return pl.pallas_call(
+        _sum_by_perm_kernel,
+        out_shape=jax.ShapeDtypeStruct(x_e.shape[:-1] + lo.shape,
+                                       x_e.dtype),
+        interpret=interpret,
+    )(x_e, perm, lo, hi)
+
+
+def _spread_kernel(x_ref, idx_ref, out_ref):
+    out_ref[...] = jnp.take(x_ref[...], idx_ref[...], axis=-1)
+
+
+def spread(x_n, idx_e, *, interpret: bool):
+    """[..., N] -> [..., E]: broadcast a per-node quantity to incident
+    edges (``idx_e`` = edge_dst for _spread_dst, edge_src for
+    _spread_src)."""
+    return pl.pallas_call(
+        _spread_kernel,
+        out_shape=jax.ShapeDtypeStruct(x_n.shape[:-1] + idx_e.shape,
+                                       x_n.dtype),
+        interpret=interpret,
+    )(x_n, idx_e)

@@ -62,6 +62,8 @@ from chandy_lamport_tpu.core.state import (
     pack_marker_data,
     pack_meta,
 )
+from chandy_lamport_tpu.kernels import queue as plk_queue
+from chandy_lamport_tpu.kernels import segment as plk_segment
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 from chandy_lamport_tpu.utils.tracing import (
     EV_FAULT,
@@ -250,6 +252,7 @@ class TickKernel:
     def __init__(self, topo: DenseTopology, cfg: SimConfig, delay: JaxDelay,
                  marker_mode: str = "ring", exact_impl: str = "cascade",
                  megatick: int = 8, queue_engine: str = "auto",
+                 kernel_engine: str | None = None,
                  faults=None, quarantine: bool = False, trace=None):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
@@ -354,6 +357,21 @@ class TickKernel:
                 "(or the sync scheduler) with snapshot_timeout/"
                 "snapshot_every")
         queue_engine = resolve_queue_engine(queue_engine)
+        # kernel_engine routes the queue head/select/pop/append chain and
+        # the edge->node reductions through the fused Pallas kernels
+        # (chandy_lamport_tpu/kernels) instead of the stock-XLA
+        # formulations below. None defers to cfg.kernel_engine; the
+        # RESOLVED engine is stored ("auto" never picks the interpret-mode
+        # emulation — kernels.resolve_kernel_engine). Bit-identical either
+        # way (tests/test_pallas_kernels.py).
+        from chandy_lamport_tpu.kernels import (
+            pallas_interpret,
+            resolve_kernel_engine,
+        )
+
+        self.kernel_engine = resolve_kernel_engine(
+            cfg.kernel_engine if kernel_engine is None else kernel_engine)
+        self._pl_interpret = pallas_interpret()
         if megatick < 1:
             raise ValueError(f"megatick must be >= 1, got {megatick}")
         if exact_impl not in ("cascade", "fold", "wave"):
@@ -510,6 +528,13 @@ class TickKernel:
         integer-exact; matmul mode routes token AMOUNTS through the f32
         incidence matrix (caller flags >= 2^24 values) and COUNTS through
         the count-dtype copy (bf16 when the degree bound proves it exact)."""
+        if self.kernel_engine == "pallas":
+            # safe against BOTH stock modes: every reduction here is an
+            # exact integer (matmul is gated to exact regimes), and the
+            # kernel keeps the segsum math verbatim
+            return plk_segment.sum_by_perm(
+                x_e, self._by_dst, self._dst_lo, self._dst_hi,
+                interpret=self._pl_interpret)
         if self._mode == "segsum":
             xs = jnp.take(x_e.astype(_i32), self._by_dst, axis=-1)
             return self._segment_sums(xs, self._dst_lo, self._dst_hi)
@@ -518,6 +543,10 @@ class TickKernel:
 
     def _sum_by_src(self, x_e):
         """Per-source-node sums (edges are already src-sorted)."""
+        if self.kernel_engine == "pallas":
+            return plk_segment.sum_segments(
+                x_e, self._src_lo, self._src_hi,
+                interpret=self._pl_interpret)
         return self._segment_sums(x_e, self._src_lo, self._src_hi)
 
     def _spread_dst(self, x_n):
@@ -525,6 +554,9 @@ class TickKernel:
         inbound edges. Matmul on the MXU in matmul mode (measured ~10%
         faster per tick than the gather at the 1k-node bench shape);
         static-index take in segsum mode (no [N, E] constants)."""
+        if self.kernel_engine == "pallas":
+            return plk_segment.spread(x_n, self._edge_dst,
+                                      interpret=self._pl_interpret)
         if self._mode == "matmul":
             return (x_n.astype(self._cnt) @ self._A_in_c) > 0.5
         return jnp.take(x_n, self._edge_dst, axis=-1)
@@ -532,6 +564,9 @@ class TickKernel:
     def _spread_src(self, x_n):
         """[..., N] bool -> [..., E]: broadcast a per-node flag to its
         outbound edges (marker re-broadcast targets)."""
+        if self.kernel_engine == "pallas":
+            return plk_segment.spread(x_n, self._edge_src,
+                                      interpret=self._pl_interpret)
         if self._mode == "matmul":
             return (x_n.astype(self._cnt) @ self._A_out_c) > 0.5
         return jnp.take(x_n, self._edge_src, axis=-1)
@@ -845,7 +880,11 @@ class TickKernel:
         ``queue_engine``: ONE [E] gather per packed plane
         (``take_along_axis`` at q_head), or the legacy [E, C] one-hot mask
         reductions. Heads of empty queues read their stale slot either way
-        (callers gate on q_len > 0), so the engines are bit-identical."""
+        (callers gate on q_len > 0), so the engines are bit-identical.
+        kernel_engine="pallas" overrides both with the fused VMEM pass."""
+        if self.kernel_engine == "pallas":
+            return plk_queue.head_fields(s.q_meta, s.q_data, s.q_head,
+                                         interpret=self._pl_interpret)
         if self.queue_engine == "gather":
             head_meta = jnp.take_along_axis(
                 s.q_meta, s.q_head[:, None], axis=-1)[..., 0]
@@ -876,6 +915,21 @@ class TickKernel:
         rt_e = jnp.asarray(rt_e, _i32)
         data_e = jnp.broadcast_to(jnp.asarray(data_e, _i32), active.shape)
         meta_e = pack_meta(rt_e, mk_e)
+        if self.kernel_engine == "pallas":
+            q_meta, q_data, err = plk_queue.append_rows(
+                s.q_meta, s.q_data, s.q_head, s.q_len, s.tok_pushed,
+                active,
+                jnp.broadcast_to(meta_e, active.shape),
+                jnp.broadcast_to(rt_e, active.shape), data_e,
+                capacity=C, key_limit=self._key_limit,
+                interpret=self._pl_interpret)
+            return s._replace(
+                q_meta=q_meta,
+                q_data=q_data,
+                q_len=s.q_len + active.astype(_i32),
+                tok_pushed=s.tok_pushed + active.astype(_i32),
+                error=s.error | err[0],
+            )
         err = (jnp.any(active & (s.q_len >= C)).astype(_i32)
                * ERR_QUEUE_OVERFLOW
                | (jnp.any(active & (s.tok_pushed >= self._key_limit))
@@ -1156,6 +1210,17 @@ class TickKernel:
         O(E·C) one-hot reductions. Returns (s, tok_pend, mk_pend,
         head_data)."""
         C = self.cfg.queue_capacity
+        if self.kernel_engine == "pallas" and self.faults is None:
+            # the fully fused form: head gather + eligibility + selection
+            # + pop in one VMEM pass (the fault path below splits at the
+            # eligibility gates so adversary semantics stay byte-for-byte)
+            tok_pend, mk_pend, head_data, new_head, new_len = (
+                plk_queue.queue_step(
+                    s.q_meta, s.q_data, s.q_head, s.q_len, s.time,
+                    self._src_first, capacity=C,
+                    interpret=self._pl_interpret))
+            return (s._replace(q_head=new_head, q_len=new_len),
+                    tok_pend, mk_pend, head_data)
         head_rt, head_mk, head_data = self._head_fields(s)
         elig = (s.q_len > 0) & (head_rt <= s.time)
         if self.faults is not None:
@@ -1166,6 +1231,12 @@ class TickKernel:
             _, _, jit_e, _ = self._fault_edge_masks(s)
             _, _, mjit_e, _ = self._fault_marker_masks(s)
             s, elig = self._fault_gate_elig(s, elig, jit_e, mjit_e, head_mk)
+        if self.kernel_engine == "pallas":
+            sel, new_head, new_len = plk_queue.select_pop(
+                s.q_head, s.q_len, elig, self._src_first, capacity=C,
+                interpret=self._pl_interpret)
+            s = s._replace(q_head=new_head, q_len=new_len)
+            return s, sel & ~head_mk, sel & head_mk, head_data
         # first eligible edge per source in dest order (same O(E) prefix-
         # count formulation as _sync_tick; edges are per-source contiguous)
         elig_i = elig.astype(_i32)
@@ -1181,6 +1252,10 @@ class TickKernel:
         """HandleToken's balance half (node.go:175), vectorized: cheap
         [E] -> [N] integer segment sums, applied eagerly per chunk so
         _create_local freezes the right balances (node.go:77)."""
+        if self.kernel_engine == "pallas":
+            return s._replace(tokens=s.tokens + plk_segment.sum_by_perm(
+                jnp.where(mask, amt_e, 0), self._by_dst, self._dst_lo,
+                self._dst_hi, interpret=self._pl_interpret))
         xs = jnp.take(jnp.where(mask, amt_e, 0), self._by_dst, axis=-1)
         return s._replace(tokens=s.tokens + self._segment_sums(
             xs, self._dst_lo, self._dst_hi))

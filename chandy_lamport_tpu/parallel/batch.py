@@ -216,7 +216,8 @@ class BatchedRunner:
                  delay: JaxDelay, batch: int, scheduler: str = "exact",
                  check_every: int = 0, exact_impl: str = "cascade",
                  auto_layouts: bool = False, megatick: int = 1,
-                 queue_engine: str = "auto", faults=None,
+                 queue_engine: str = "auto",
+                 kernel_engine: Optional[str] = None, faults=None,
                  quarantine: bool = False, trace=None):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
@@ -323,9 +324,10 @@ class BatchedRunner:
             self.topo, self.config, self.delay,
             marker_mode="split" if scheduler == "sync" else "ring",
             exact_impl=exact_impl, megatick=megatick,
-            queue_engine=queue_engine, faults=faults,
-            quarantine=quarantine, trace=trace)
+            queue_engine=queue_engine, kernel_engine=kernel_engine,
+            faults=faults, quarantine=quarantine, trace=trace)
         self.queue_engine = self.kernel.queue_engine
+        self.kernel_engine = self.kernel.kernel_engine
         self.faults = faults
         self.quarantine = bool(quarantine)
         self._trace_on = self.kernel._trace_on

@@ -273,7 +273,8 @@ class GraphShardedRunner:
                  mesh: Mesh, axis: str = "graph", seed: int = 0,
                  max_delay: int = 5, fixed_delay: Optional[int] = None,
                  check_every: int = 0, queue_engine: str = "auto",
-                 comm_engine: Optional[str] = None, megatick: int = 1,
+                 comm_engine: Optional[str] = None,
+                 kernel_engine: Optional[str] = None, megatick: int = 1,
                  quarantine: bool = False, trace=None):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
@@ -339,6 +340,16 @@ class GraphShardedRunner:
 
         self.comm_engine = resolve_comm_engine(
             self.config.comm_engine if comm_engine is None else comm_engine)
+        # tick-kernel engine (chandy_lamport_tpu.kernels): None defers to
+        # the config knob, same contract as comm_engine; bit-identical
+        from chandy_lamport_tpu.kernels import (
+            pallas_interpret,
+            resolve_kernel_engine,
+        )
+        self.kernel_engine = resolve_kernel_engine(
+            self.config.kernel_engine if kernel_engine is None
+            else kernel_engine)
+        self._pl_interpret = pallas_interpret()
         if megatick < 1:
             raise ValueError("megatick must be >= 1")
         self.megatick = int(megatick)
@@ -551,7 +562,14 @@ class GraphShardedRunner:
         """Every local ring head's (rtime, amount) by ``queue_engine``:
         one [Em] gather per packed plane, or the legacy [Em, C] one-hot
         reductions (TickKernel._head_fields' shard-local twin; the split
-        ring's marker bit is always 0 so only rtime/amount are decoded)."""
+        ring's marker bit is always 0 so only rtime/amount are decoded).
+        kernel_engine="pallas" overrides both with the fused VMEM pass."""
+        if self.kernel_engine == "pallas":
+            from chandy_lamport_tpu.kernels import queue as plk_queue
+
+            rt, _, amt = plk_queue.head_fields(
+                s.q_meta, s.q_data, s.q_head, interpret=self._pl_interpret)
+            return rt, amt
         if self.queue_engine == "gather":
             head_meta = jnp.take_along_axis(
                 s.q_meta, s.q_head[:, None], axis=-1)[..., 0]
@@ -577,6 +595,26 @@ class GraphShardedRunner:
         C = self.config.queue_capacity
         rt_e = jnp.asarray(rt_e, _i32)
         data_e = jnp.asarray(data_e, _i32)
+        if self.kernel_engine == "pallas":
+            from chandy_lamport_tpu.kernels import queue as plk_queue
+
+            # queue overflow is booked by the dense differential, not
+            # here (pad edges never fill) — gate that bit off so the err
+            # word matches the stock formulation below exactly
+            q_meta, q_data, err = plk_queue.append_rows(
+                s.q_meta, s.q_data, s.q_head, s.q_len, s.tok_pushed,
+                active,
+                jnp.broadcast_to(pack_meta(rt_e, False), active.shape),
+                jnp.broadcast_to(rt_e, active.shape),
+                jnp.broadcast_to(data_e, active.shape),
+                capacity=C, key_limit=self._key_limit,
+                flag_queue_overflow=False, interpret=self._pl_interpret)
+            return s._replace(
+                q_meta=q_meta,
+                q_data=q_data,
+                q_len=s.q_len + active.astype(_i32),
+                tok_pushed=s.tok_pushed + active.astype(_i32),
+            ), err[0]
         err = (jnp.any(active & (s.tok_pushed >= self._key_limit))
                | jnp.any(active & (rt_e >= RTIME_PACK_LIMIT))
                ).astype(_i32) * ERR_VALUE_OVERFLOW
@@ -1343,6 +1381,7 @@ class GraphShardedRunner:
             "shards": self.shards,
             "comm_engine": self.comm_engine,
             "queue_engine": self.queue_engine,
+            "kernel_engine": self.kernel_engine,
             "megatick": self.megatick,
             "total_ticks": int(np.sum(np.asarray(h.time))),
             "error_bits": bits,

@@ -43,11 +43,13 @@ BACKEND_INIT = (None, False, True, True)       # clean EXIT_BACKEND_INIT
 CLEAN_FAIL = (None, False, False, False)       # deterministic rc=1
 
 
-def run_main(monkeypatch, capsys, argv, outcomes, platform="tpu"):
+def run_main(monkeypatch, capsys, argv, outcomes, platform="tpu",
+             dead_platform=None):
     spawn = ScriptedSpawn(outcomes)
     monkeypatch.setattr(bench, "_spawn", spawn)
     monkeypatch.setattr(bench, "_find_live_platform",
-                        lambda args: (platform, {}, False))
+                        lambda args: (platform, {}, dead_platform is not None,
+                                      dead_platform))
     rc = bench.main(argv)
     assert rc == 0  # the orchestrator always exits 0 with one JSON line
     out = capsys.readouterr().out.strip().splitlines()
@@ -195,7 +197,9 @@ def test_worker_row_round_trips_supervisor_knobs(capsys):
 
 def test_dead_probe_path_tries_tpu_blind_then_cpu(monkeypatch, capsys):
     # every probe hung: one blind full-size TPU attempt before the cpu
-    # fallback (the round-3 official number was lost to skipping this)
+    # fallback (the round-3 official number was lost to skipping this).
+    # This is the GENERIC-hang path — no platform was positively
+    # identified as unusable, so the tunnel may still recover mid-window
     calls, row = run_main(
         monkeypatch, capsys, ["--timeout", "60"],
         {"tpu-blind": HANG,
@@ -204,3 +208,107 @@ def test_dead_probe_path_tries_tpu_blind_then_cpu(monkeypatch, capsys):
         platform=None)
     assert calls == ["tpu-blind", "cpu"]
     assert row["platform"] == "cpu"
+
+
+def test_unusable_platform_verdict_skips_tpu_blind(monkeypatch, capsys):
+    # the probe watchdog positively identified a known-unusable platform
+    # (UNUSABLE_PLATFORMS, e.g. the experimental axon plugin whose
+    # jax.devices() hangs): the hang is structural, so the ladder must
+    # fall straight to the labeled cpu row — no 600s tpu-blind burn
+    # (the BENCH_r05 failure this satellite exists for)
+    calls, row = run_main(
+        monkeypatch, capsys, ["--timeout", "60"],
+        {"cpu": ({"metric": "node_ticks_per_sec_per_chip", "value": 1.0,
+                  "platform": "cpu"}, False, False, False)},
+        platform=None, dead_platform="axon")
+    assert calls == ["cpu"]
+    assert row["platform"] == "cpu"
+
+
+def _probe_args(tmp_path, **over):
+    """A parsed-args namespace for _find_live_platform tests."""
+    defaults = {"no_probe_cache": False, "probe_cache_ttl": 3600.0,
+                "probe_timeout": 60.0}
+    defaults.update(over)
+    return type("Args", (), defaults)()
+
+
+def test_find_live_platform_dead_verdict_cached(monkeypatch, tmp_path):
+    """A probe leg answering with a watchdog 'dead' line ends the ladder
+    immediately (no probe-retry / probe-auto — the plugin would hang
+    identically), records dead_platform in the verdict cache, and the
+    NEXT invocation short-circuits with zero probe subprocesses."""
+    cache = str(tmp_path / "probe_verdict.json")
+    monkeypatch.setattr(bench, "PROBE_CACHE_PATH", cache)
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    dead_line = ({"probe": "dead", "platform": "axon",
+                  "reason": "watchdog"}, False, False, False)
+    spawn = ScriptedSpawn({"probe": dead_line})
+    monkeypatch.setattr(bench, "_spawn", spawn)
+    platform, env, recently_dead, dead = bench._find_live_platform(
+        _probe_args(tmp_path))
+    assert (platform, env, recently_dead, dead) == (None, {}, True, "axon")
+    assert spawn.calls == ["probe"]  # no retry, no probe-auto
+    with open(cache) as f:
+        assert json.load(f)["dead_platform"] == "axon"
+    # second invocation: the cached dead-platform verdict short-circuits
+    spawn2 = ScriptedSpawn({})
+    monkeypatch.setattr(bench, "_spawn", spawn2)
+    platform, env, recently_dead, dead = bench._find_live_platform(
+        _probe_args(tmp_path))
+    assert (platform, recently_dead, dead) == (None, True, "axon")
+    assert spawn2.calls == []  # zero probe subprocesses
+
+
+def test_find_live_platform_live_verdict_unchanged(monkeypatch, tmp_path):
+    """A live probe still resolves and caches exactly as before (no
+    dead_platform) — the axon fail-fast must not disturb the happy path."""
+    cache = str(tmp_path / "probe_verdict.json")
+    monkeypatch.setattr(bench, "PROBE_CACHE_PATH", cache)
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    ok_line = ({"probe": "ok", "platform": "tpu",
+                "device_kind": "x"}, False, False, False)
+    spawn = ScriptedSpawn({"probe": ok_line})
+    monkeypatch.setattr(bench, "_spawn", spawn)
+    platform, env, recently_dead, dead = bench._find_live_platform(
+        _probe_args(tmp_path))
+    assert (platform, env, recently_dead, dead) == ("tpu", {}, False, None)
+    with open(cache) as f:
+        data = json.load(f)
+    assert data["platform"] == "tpu" and not data.get("dead_platform")
+
+
+# xla is the default every other worker test already measures; the pallas
+# worker is the row-attribution case that needs its own compile
+@pytest.mark.parametrize("engine", [
+    pytest.param("xla", marks=pytest.mark.slow), "pallas"])
+def test_worker_row_round_trips_kernel_engine(engine, capsys):
+    """A real (tiny, CPU) --worker measurement under each tick-kernel
+    engine: the JSON row must carry the kernel_engine that actually ran,
+    so BENCH_*.json rows attribute wins to the right engine (and the
+    pallas run exercises the interpret-mode kernels end-to-end through
+    the bench worker)."""
+    rc = bench.main(["--worker", "--nodes", "16", "--batch", "2",
+                     "--phases", "3", "--snapshots", "2", "--repeats", "1",
+                     "--kernel-engine", engine])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["metric"] == "node_ticks_per_sec_per_chip"
+    assert row["kernel_engine"] == engine
+    assert row["value"] > 0
+
+
+@pytest.mark.slow
+def test_graphshard_worker_row_round_trips_kernel_engine(capsys):
+    """The graph-sharded worker row carries kernel_engine too (from
+    GraphShardedRunner.summarize), alongside the comm/queue engines."""
+    rc = bench.main(["--worker", "--graphshard", "2", "--nodes", "16",
+                     "--phases", "3", "--snapshots", "2", "--repeats", "1",
+                     "--kernel-engine", "pallas"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["mode"] == "graphshard"
+    assert row["kernel_engine"] == "pallas"
+    assert row["value"] > 0

@@ -12,8 +12,16 @@ kernels, summarize a schema-versioned JSONL stream written by the CLI's
 TelemetryWriter) — per-kind record counts, run-row headlines, and the
 decoded-event histogram.
 
+``--bench-rows FILE`` switches to bench-row mode: read a JSONL stream of
+bench worker rows (one ``bench.py`` JSON line per row, as collected by the
+ladder sweeps) and print kernel-engine comparison curves — per graph-size
+rung, throughput under ``kernel_engine=xla`` vs ``pallas`` side by side
+with the speedup, so the Pallas claim is read off measured rows instead of
+asserted.
+
 Usage: python tools/analyze.py [--nodes N] [--batch B] [--scheduler sync]
        python tools/analyze.py --telemetry runs.jsonl
+       python tools/analyze.py --bench-rows rows.jsonl
 """
 
 from __future__ import annotations
@@ -67,6 +75,62 @@ def analyze_telemetry(path: str) -> None:
               f"{len(errored)} errored")
 
 
+def analyze_bench_rows(path: str) -> None:
+    """Kernel-engine comparison curves from bench worker rows (JSONL, one
+    bench.py JSON line per row). Rows are grouped by the workload shape
+    (graph family, nodes, batch, scheduler, platform); within each group
+    the best row per kernel_engine is kept (repeat sweeps appear as
+    multiple rows) and xla/pallas are printed side by side. Unparseable
+    lines are counted and skipped — sweep logs interleave stderr noise."""
+    import json
+
+    rows, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(r, dict) and "value" in r and "kernel_engine" in r:
+                rows.append(r)
+            else:
+                skipped += 1
+    if not rows:
+        print(f"{path}: no bench rows with a kernel_engine field"
+              + (f" ({skipped} lines skipped)" if skipped else ""))
+        return
+    groups = {}
+    for r in rows:
+        key = (r.get("graph", "?"), r.get("nodes", 0), r.get("batch", 0),
+               r.get("scheduler", "?"), r.get("platform", "?"))
+        groups.setdefault(key, {})
+        eng = r["kernel_engine"]
+        best = groups[key].get(eng)
+        if best is None or r["value"] > best["value"]:
+            groups[key][eng] = r
+    print(f"{path}: {len(rows)} bench rows, {len(groups)} workload "
+          f"shapes" + (f" ({skipped} lines skipped)" if skipped else ""))
+    unit = rows[0].get("unit", "node-ticks/s")
+    print(f"  {'graph':<6} {'nodes':>6} {'batch':>6} {'sched':<6} "
+          f"{'platform':<8} {'xla':>12} {'pallas':>12} {'pallas/xla':>10}")
+    for key in sorted(groups):
+        graph, nodes, batch, sched, plat = key
+        by_eng = groups[key]
+        x = by_eng.get("xla")
+        pl = by_eng.get("pallas")
+        ratio = (f"{pl['value'] / x['value']:9.2f}x"
+                 if x and pl and x["value"] else f"{'—':>10}")
+        fmt = lambda r: f"{r['value']:12.3g}" if r else f"{'—':>12}"
+        print(f"  {graph:<6} {nodes:>6} {batch:>6} {sched:<6} "
+              f"{plat:<8} {fmt(x)} {fmt(pl)} {ratio}")
+    print(f"  (value = {unit}; best row per engine per shape; 'auto' rows "
+          "appear under their RESOLVED engine)")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=1024)
@@ -78,10 +142,16 @@ def main() -> None:
     p.add_argument("--telemetry", metavar="FILE",
                    help="summarize this JSONL telemetry stream instead of "
                         "running the kernel cost analysis")
+    p.add_argument("--bench-rows", metavar="FILE",
+                   help="print kernel-engine comparison curves from this "
+                        "JSONL stream of bench worker rows instead of "
+                        "running the kernel cost analysis")
     args = p.parse_args()
 
     if args.telemetry:
         return analyze_telemetry(args.telemetry)
+    if args.bench_rows:
+        return analyze_bench_rows(args.bench_rows)
 
     platform = os.environ.get("CLSIM_PLATFORM")
     import jax

@@ -234,6 +234,57 @@ def main() -> None:
         print(f"  {name:<12} {g * 1e3:10.3f} {m * 1e3:10.3f} "
               f"{m / g:7.2f}x", file=sys.stderr)
 
+    # ---- kernel engines A/B: the Pallas-fusion claim, measured ----------
+    # Per-op wall clock of the tick's hot ops under BOTH tick-kernel
+    # engines (SimConfig.kernel_engine): "xla" = the stock formulations,
+    # "pallas" = the fused VMEM-resident kernels (chandy_lamport_tpu/
+    # kernels). Off-TPU the pallas column is interpret-mode EMULATION —
+    # expect it to lose badly there; the comparison is about the TPU
+    # regime, the CPU run just proves both paths execute. Same state,
+    # same shapes — only the engine differs.
+    ketimings = {}
+    for engine in ("xla", "pallas"):
+        k_ke = (runner.kernel if engine == runner.kernel.kernel_engine
+                else TickKernel(runner.topo, runner.config, runner.delay,
+                                marker_mode=runner.kernel.marker_mode,
+                                exact_impl=args.exact_impl,
+                                megatick=args.megatick,
+                                queue_engine=args.queue_engine,
+                                kernel_engine=engine))
+
+        def queue_step(t, k=k_ke):
+            t = t._replace(time=t.time + 1)
+            return k._select_and_pop(t)[0]
+
+        def seg_reduce(t, k=k_ke):
+            credit = k._sum_by_dst(t.q_len > 0, amounts=False)
+            return k._spread_dst(credit > 0)
+
+        ktick = (k_ke._sync_tick if args.scheduler == "sync"
+                 else k_ke._exact_tick)
+        for name, fn in (("queue-step", queue_step),
+                         ("seg-reduce", seg_reduce),
+                         ("full-tick", ktick)):
+            jfn = jax.jit(jax.vmap(fn))
+            st = runner.init_batch_device()
+            out = jfn(st)                  # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jfn(st)
+            jax.block_until_ready(out)
+            ketimings[(engine, name)] = (time.perf_counter() - t0) / reps
+    note = ("" if dev.platform == "tpu"
+            else "; pallas is interpret-mode emulation here")
+    print(f"kernels (per call, both engines{note}):", file=sys.stderr)
+    print(f"  {'op':<12} {'xla ms':>10} {'pallas ms':>10} {'speedup':>8}",
+          file=sys.stderr)
+    for name in ("queue-step", "seg-reduce", "full-tick"):
+        x = ketimings[("xla", name)]
+        pl_t = ketimings[("pallas", name)]
+        print(f"  {name:<12} {x * 1e3:10.3f} {pl_t * 1e3:10.3f} "
+              f"{x / pl_t:7.2f}x", file=sys.stderr)
+
     # ---- refill: the streaming engine's harvest + admit tax, measured ---
     # Per-step cost of continuous lane scheduling (parallel/batch.
     # _build_stream_step): the full jitted stream step — harvest retiring
