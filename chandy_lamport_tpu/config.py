@@ -18,6 +18,22 @@ MAX_DELAY = 5
 # rand.Seed(seed + 1)).
 REFERENCE_TEST_SEED = 8053172852482175523
 
+# Declarative registry of the backend-resolved engine knobs: knob name ->
+# accepted spellings, "auto" first. Every knob follows the same pattern —
+# a resolve_<knob>() that turns "auto" into a concrete engine per backend
+# (ops/tick.resolve_queue_engine / resolve_comm_engine,
+# kernels.resolve_kernel_engine), a --<knob> CLI/bench flag, and a
+# <knob> field stamped into the bench worker JSON rows so sweep results
+# record which engine actually ran. The spelling sets live ONLY here:
+# SimConfig.__post_init__ and the runner kwarg checks validate against
+# these rows (tools/staticcheck's knob-pattern rule enforces the whole
+# pattern per row).
+ENGINE_KNOBS = {
+    "queue_engine": ("auto", "gather", "mask"),
+    "comm_engine": ("auto", "dense", "sparse"),
+    "kernel_engine": ("auto", "xla", "pallas"),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
@@ -138,11 +154,11 @@ class SimConfig:
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
-        if self.comm_engine not in ("auto", "dense", "sparse"):
-            raise ValueError("comm_engine must be 'auto', 'dense' or 'sparse'")
-        if self.kernel_engine not in ("auto", "xla", "pallas"):
-            raise ValueError(
-                "kernel_engine must be 'auto', 'xla' or 'pallas'")
+        for knob in ("comm_engine", "kernel_engine"):
+            allowed = ENGINE_KNOBS[knob]
+            if getattr(self, knob) not in allowed:
+                raise ValueError(
+                    f"{knob} must be one of {', '.join(map(repr, allowed))}")
         if (self.snapshot_timeout < 0 or self.snapshot_retries < 0
                 or self.snapshot_every < 0):
             raise ValueError(

@@ -125,54 +125,104 @@ def marker_data_epoch(data, max_snapshots: int):
     ops/tick.TickKernel._reject_stale compares it to ``snap_epoch``)."""
     return data // max_snapshots
 
-ERROR_NAMES = {
-    ERR_QUEUE_OVERFLOW: "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)",
-    ERR_SNAPSHOT_OVERFLOW: "concurrent snapshot slots exceeded (raise SimConfig.max_snapshots)",
-    ERR_RECORD_OVERFLOW: "recorded-message capacity exceeded (raise SimConfig.max_recorded)",
-    ERR_TOKEN_UNDERFLOW: "node sent more tokens than it had (reference log.Fatal, node.go:113-116)",
-    ERR_TICK_LIMIT: "drain loop hit max_ticks (graph not strongly connected?)",
-    ERR_VALUE_OVERFLOW: "a value-range bound was exceeded: token amount "
-                        ">= 2^24 on the sync scheduler's f32 reductions "
-                        "(use scheduler='exact'), a recorded amount beyond "
-                        "the configured record_dtype range (use "
-                        "record_dtype='int32'), or an edge's token-push "
-                        "counter reached the FIFO merge-key bound "
-                        "(ops/tick.merge_key_limit — fewer tokens per edge "
-                        "or a smaller max_snapshots), or a receive time "
-                        "reached the packed ring-slot bound "
-                        "(state.RTIME_PACK_LIMIT, ~10^9 simulated ticks)",
-    ERR_CONSERVATION: "in-run token-conservation check failed "
-                      "(node balances + in-flight != initial total; "
-                      "BatchedRunner check_every — the reference's "
-                      "checkTokens invariant, test_common.go:298-328, "
-                      "evaluated inside the jit run)",
-    ERR_FAULT_UNRECOVERED: "a lossy node crash restarted with no completed "
-                           "Chandy-Lamport snapshot to restore from "
-                           "(models/faults.py crash_mode='lossy': the "
-                           "node's un-snapshotted balance is gone; "
-                           "quarantine the lane or schedule snapshots "
-                           "ahead of the crash windows)",
-    ERR_SNAPSHOT_TIMEOUT: "a snapshot attempt missed its "
-                          "SimConfig.snapshot_timeout deadline "
-                          "snapshot_retries times in a row and was marked "
-                          "failed by the supervisor (sustained marker loss "
-                          "beyond the retry budget — raise the timeout/"
-                          "retries, or lower the marker fault rates)",
-}
+class ErrorBit(NamedTuple):
+    """One ERROR_REGISTRY row: the ERR_ constant's name, its bit, and the
+    long diagnostic message ``decode_errors`` surfaces for it."""
+
+    name: str
+    bit: int
+    message: str
+
+
+# THE declarative error-bit registry: exactly one row per ERR_ constant
+# above, binding name, bit and diagnostic text in one place. Everything
+# that touches the error plane derives from it — the decode dicts below,
+# NUM_ERROR_BITS (which sizes graphshard's _por bit-plane reduction), and
+# the CLI/bench/soak output that prints the short names.
+# tools/staticcheck's err-bit-registry rule enforces the invariants:
+# distinct power-of-two bits with no gaps, row/constant agreement both
+# ways, and NUM_ERROR_BITS = len(ERROR_REGISTRY) rather than a second
+# literal that can drift.
+ERROR_REGISTRY: Tuple[ErrorBit, ...] = (
+    ErrorBit("ERR_QUEUE_OVERFLOW", ERR_QUEUE_OVERFLOW,
+             "per-edge queue capacity exceeded (raise SimConfig.queue_capacity)"),
+    ErrorBit("ERR_SNAPSHOT_OVERFLOW", ERR_SNAPSHOT_OVERFLOW,
+             "concurrent snapshot slots exceeded (raise SimConfig.max_snapshots)"),
+    ErrorBit("ERR_RECORD_OVERFLOW", ERR_RECORD_OVERFLOW,
+             "recorded-message capacity exceeded (raise SimConfig.max_recorded)"),
+    ErrorBit("ERR_TOKEN_UNDERFLOW", ERR_TOKEN_UNDERFLOW,
+             "node sent more tokens than it had (reference log.Fatal, node.go:113-116)"),
+    ErrorBit("ERR_TICK_LIMIT", ERR_TICK_LIMIT,
+             "drain loop hit max_ticks (graph not strongly connected?)"),
+    ErrorBit("ERR_VALUE_OVERFLOW", ERR_VALUE_OVERFLOW,
+             "a value-range bound was exceeded: token amount "
+             ">= 2^24 on the sync scheduler's f32 reductions "
+             "(use scheduler='exact'), a recorded amount beyond "
+             "the configured record_dtype range (use "
+             "record_dtype='int32'), or an edge's token-push "
+             "counter reached the FIFO merge-key bound "
+             "(ops/tick.merge_key_limit — fewer tokens per edge "
+             "or a smaller max_snapshots), or a receive time "
+             "reached the packed ring-slot bound "
+             "(state.RTIME_PACK_LIMIT, ~10^9 simulated ticks)"),
+    ErrorBit("ERR_CONSERVATION", ERR_CONSERVATION,
+             "in-run token-conservation check failed "
+             "(node balances + in-flight != initial total; "
+             "BatchedRunner check_every — the reference's "
+             "checkTokens invariant, test_common.go:298-328, "
+             "evaluated inside the jit run)"),
+    ErrorBit("ERR_FAULT_UNRECOVERED", ERR_FAULT_UNRECOVERED,
+             "a lossy node crash restarted with no completed "
+             "Chandy-Lamport snapshot to restore from "
+             "(models/faults.py crash_mode='lossy': the "
+             "node's un-snapshotted balance is gone; "
+             "quarantine the lane or schedule snapshots "
+             "ahead of the crash windows)"),
+    ErrorBit("ERR_SNAPSHOT_TIMEOUT", ERR_SNAPSHOT_TIMEOUT,
+             "a snapshot attempt missed its "
+             "SimConfig.snapshot_timeout deadline "
+             "snapshot_retries times in a row and was marked "
+             "failed by the supervisor (sustained marker loss "
+             "beyond the retry budget — raise the timeout/"
+             "retries, or lower the marker fault rates)"),
+)
+
+# number of live bits in the error plane — graphshard._por and the decode
+# tables size themselves from this, so adding a registry row widens them all
+NUM_ERROR_BITS = len(ERROR_REGISTRY)
+
+ERROR_NAMES = {row.bit: row.message for row in ERROR_REGISTRY}
 
 # short symbol-style names for user-facing output (CLI counters, bench JSON
 # rows, soak logs) — the long ERROR_NAMES messages stay the diagnostic text
-ERROR_BIT_NAMES = {
-    ERR_QUEUE_OVERFLOW: "ERR_QUEUE_OVERFLOW",
-    ERR_SNAPSHOT_OVERFLOW: "ERR_SNAPSHOT_OVERFLOW",
-    ERR_RECORD_OVERFLOW: "ERR_RECORD_OVERFLOW",
-    ERR_TOKEN_UNDERFLOW: "ERR_TOKEN_UNDERFLOW",
-    ERR_TICK_LIMIT: "ERR_TICK_LIMIT",
-    ERR_VALUE_OVERFLOW: "ERR_VALUE_OVERFLOW",
-    ERR_CONSERVATION: "ERR_CONSERVATION",
-    ERR_FAULT_UNRECOVERED: "ERR_FAULT_UNRECOVERED",
-    ERR_SNAPSHOT_TIMEOUT: "ERR_SNAPSHOT_TIMEOUT",
-}
+ERROR_BIT_NAMES = {row.bit: row.name for row in ERROR_REGISTRY}
+
+# Checkpoint-format version history: one row per breaking layout change of
+# the serialized state pytree (utils/checkpoint.py reads/writes the
+# header). The row text says what changed and why an older file must error
+# rather than load; versions are consecutive from 1 and the live version
+# IS the last row, so the supported-range error message stays truthful
+# (tools/staticcheck's ckpt-history rule enforces both, and its
+# ckpt-version-literal rule keeps restated version literals out of the
+# rest of the tree).
+CHECKPOINT_FORMAT_HISTORY: Tuple[Tuple[int, str], ...] = (
+    (1, "round-2 DenseState (q_seq/seq_next/m_seq/rec_len/rec_data leaves)"),
+    (2, "window-log/merge-key state (tok_pushed/mk_cnt/m_key/rec_cnt/"
+        "min_prot/log_amt/rec_start/rec_end) + three-word hash-delay state"),
+    (3, "packed ring slots: q_marker/q_data/q_rtime became "
+        "q_meta (rtime << 1 | is_marker) + full-range q_data"),
+    (4, "fault-adversary leaves (fault_key/fault_skew/fault_counts) join "
+        "the carry; writes became atomic (tmp-then-os.replace)"),
+    (5, "snapshot-supervisor leaves (snap_epoch/snap_deadline/snap_retries/"
+        "snap_initiator/snap_failed/snap_done_time + stale_markers); "
+        "fault_counts widens to [7] with the marker-plane classes"),
+    (6, "streaming-engine leaves (job_id/prog_cursor/admit_tick): per-lane "
+        "job identity resumes mid-queue admission bit-exactly"),
+    (7, "flight-recorder leaves (tr_meta/tr_data/tr_tick/tr_count/tr_on): "
+        "the device trace ring and its dropped-events accounting survive "
+        "a kill mid-run"),
+)
+CHECKPOINT_FORMAT_VERSION = CHECKPOINT_FORMAT_HISTORY[-1][0]
 
 
 class DenseTopology:

@@ -49,7 +49,11 @@ import logging
 
 logger = logging.getLogger(__name__)
 
-KERNEL_ENGINES = ("auto", "xla", "pallas")
+# bound from the declarative knob table so the spelling set lives in one
+# place (config.ENGINE_KNOBS); kept exported under the historical name
+from chandy_lamport_tpu.config import ENGINE_KNOBS as _ENGINE_KNOBS
+
+KERNEL_ENGINES = _ENGINE_KNOBS["kernel_engine"]
 
 
 def resolve_kernel_engine(engine: str, backend: str | None = None) -> str:

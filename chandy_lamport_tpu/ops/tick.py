@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.config import ENGINE_KNOBS, SimConfig
 from chandy_lamport_tpu.core.state import (
     DenseState,
     DenseTopology,
@@ -135,7 +135,7 @@ def resolve_queue_engine(engine: str, backend: str | None = None) -> str:
     "queue ops" A/B), the same backend asymmetry count_dtype gates on.
     ``backend`` defaults to the live jax backend; parameterized so CI can
     pin the TPU decision from the CPU mesh."""
-    if engine not in ("auto", "gather", "mask"):
+    if engine not in ENGINE_KNOBS["queue_engine"]:
         raise ValueError(f"unknown queue_engine {engine!r}")
     if engine != "auto":
         return engine
@@ -158,7 +158,7 @@ def resolve_comm_engine(engine: str, backend: str | None = None) -> str:
     is retained as the in-tree differential oracle. ``backend`` is
     accepted for symmetry with resolve_queue_engine / count_dtype should
     a backend ever want the dense plane back."""
-    if engine not in ("auto", "dense", "sparse"):
+    if engine not in ENGINE_KNOBS["comm_engine"]:
         raise ValueError(f"unknown comm_engine {engine!r}")
     if engine != "auto":
         return engine
@@ -1060,7 +1060,7 @@ class TickKernel:
             rts_k = jnp.asarray(self.delay.block_receive_times(
                 s.delay_state, s.time, off), _i32)
             dstate = self.delay.advance_draws(
-                s.delay_state, jnp.sum(valid.astype(_i32)))
+                s.delay_state, jnp.sum(valid, dtype=_i32))
         else:
             # order-dependent sampler (GoExact): the draws stay a
             # sequential scan, but it carries only the sampler state —
@@ -1359,7 +1359,13 @@ class TickKernel:
         def body(carry):
             s, mk, tok, app = carry
             found = jnp.any(mk)
-            e = jnp.argmax(mk)                  # lowest edge = lowest source
+            # lowest edge = lowest source. Formulated as a min-over-mask
+            # rather than argmax: argmax yields the platform int (i64
+            # under x64) and the index feeds [E]-plane compares; the
+            # found=False sentinel mirrors argmax's 0 so batched inactive
+            # lanes trace identically.
+            e = jnp.min(jnp.where(mk, self._rows_e, _i32(self.topo.e)))
+            e = jnp.where(jnp.any(mk), e, _i32(0))
             r = jnp.where(found, self._edge_src[e], _i32(self.topo.n))
             tmask = tok & (self._edge_src < r)
             s = credit(s, tmask)
@@ -1470,7 +1476,8 @@ class TickKernel:
         earlier_d = self._seg_excl(
             jnp.take(pend_se.astype(_i32), self._by_dst, axis=-1))
         earlier_se = jnp.take(earlier_d, self._inv_by_dst, axis=-1)
-        earlier_same = jnp.sum(jnp.where(pend_se, earlier_se, 0), axis=-2)
+        earlier_same = jnp.sum(jnp.where(pend_se, earlier_se, 0), axis=-2,
+                               dtype=_i32)
         hl_e = jnp.any(onehot_se & jnp.take(s.has_local, self._edge_dst,
                                             axis=-1), axis=-2)     # [E]
         first_e = mk_pend & ~hl_e & (earlier_same == 0)
@@ -1480,7 +1487,7 @@ class TickKernel:
         # read their slices positionally from the frozen pre-tick state
         dstate0 = s.delay_state
         s = s._replace(delay_state=self.delay.advance_draws(
-            dstate0, jnp.sum(draws_e, axis=-1)))
+            dstate0, jnp.sum(draws_e, axis=-1, dtype=_i32)))
         # wave number: each pending marker's rank among its destination's
         # pending markers (fold order within the destination, ANY sid) —
         # computed ONCE per tick; wave k just masks wnum == k
@@ -1762,7 +1769,8 @@ class TickKernel:
         if self._trace_on:
             # the consumed front's plane index IS the snapshot id
             sid_e = jnp.sum(jnp.where(
-                mk_se, jnp.arange(S, dtype=_i32)[:, None], 0), axis=-2)
+                mk_se, jnp.arange(S, dtype=_i32)[:, None], 0), axis=-2,
+                dtype=_i32)
             s = trace_append_many(s, mk_e, EV_MRECV, self._rows_e, sid_e)
         arrivals = self._sum_by_dst(mk_se, amounts=False)          # [S, N]
         had = s.has_local                                          # [S, N]

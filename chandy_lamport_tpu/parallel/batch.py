@@ -528,7 +528,11 @@ class BatchedRunner:
             ], s)
 
         def run(s):
-            s = lax.fori_loop(0, kind.shape[0], body, s)
+            # i32 bounds pin the induction var: a Python-int bound makes j
+            # the platform int under x64 and drags the kind/arg gathers'
+            # index arithmetic up to i64
+            s = lax.fori_loop(jnp.int32(0), jnp.int32(kind.shape[0]),
+                              body, s)
             # do_tick is a COUNT (compile_events): the whole stretch runs
             # under the fused multi-tick engine, one phase per stretch
             return lax.cond(do_tick != 0,

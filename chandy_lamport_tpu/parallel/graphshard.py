@@ -62,6 +62,7 @@ from chandy_lamport_tpu.core.state import (
     ERR_TOKEN_UNDERFLOW,
     ERR_VALUE_OVERFLOW,
     F32_EXACT_LIMIT,
+    NUM_ERROR_BITS,
     RTIME_PACK_LIMIT,
     DenseTopology,
     meta_rtime,
@@ -551,7 +552,7 @@ class GraphShardedRunner:
         smaller bit and decode_errors would mislabel the cause. Per-bit
         psum>0 preserves every flag."""
         mask = jnp.asarray(mask, _i32)
-        shifts = jnp.arange(9, dtype=_i32)  # 9 ERR_ bits defined (state.py)
+        shifts = jnp.arange(NUM_ERROR_BITS, dtype=_i32)
         bits = (mask[..., None] >> shifts) & 1
         any_bit = lax.psum(bits, self.axis) > 0
         return jnp.sum(any_bit.astype(_i32) << shifts, axis=-1, dtype=_i32)
@@ -797,13 +798,19 @@ class GraphShardedRunner:
         def sel(old, new):
             return jnp.where(active, new, old)
 
+        # every index is in bounds by construction (src_l clipped, pos
+        # taken mod C, e a live edge id), so the scatters may skip XLA's
+        # out-of-bounds select
         return s._replace(
-            tokens=s.tokens.at[src_l].add(-amt_i * a),
-            q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i)),
+            tokens=s.tokens.at[src_l].add(-amt_i * a,
+                                          mode="promise_in_bounds"),
+            q_data=s.q_data.at[e, pos].set(sel(s.q_data[e, pos], amt_i),
+                                           mode="promise_in_bounds"),
             q_meta=s.q_meta.at[e, pos].set(
-                sel(s.q_meta[e, pos], pack_meta(rt, False))),
-            q_len=s.q_len.at[e].add(a),
-            tok_pushed=s.tok_pushed.at[e].add(a),
+                sel(s.q_meta[e, pos], pack_meta(rt, False)),
+                mode="promise_in_bounds"),
+            q_len=s.q_len.at[e].add(a, mode="promise_in_bounds"),
+            tok_pushed=s.tok_pushed.at[e].add(a, mode="promise_in_bounds"),
             delay_key=key,
         ), erl | err_local | (
             (a & ((s.tok_pushed[e] >= self._key_limit)

@@ -36,41 +36,15 @@ from typing import Tuple
 import jax
 import numpy as np
 
-from chandy_lamport_tpu.core.state import DenseState
+from chandy_lamport_tpu.core.state import CHECKPOINT_FORMAT_VERSION, DenseState
 
-# version history:
-#   1 — round-2 DenseState (q_seq/seq_next/m_seq/rec_len/rec_data leaves)
-#   2 — round-3 window-log/merge-key state (tok_pushed/mk_cnt/m_key/rec_cnt/
-#       min_prot/log_amt/rec_start/rec_end) + round-4 three-word hash-delay
-#       state; old checkpoints get the unsupported-version error instead of
-#       a misleading leaf-count mismatch
-#   3 — PR-2 packed ring slots: the q_marker/q_data/q_rtime planes became
-#       q_meta (rtime << 1 | is_marker) + q_data (core/state.py "Packed
-#       ring slots"); a version-2 checkpoint's separate marker/rtime leaves
-#       cannot be reinterpreted, so they error here rather than misdecode
-#   4 — PR-3 fault-adversary leaves (fault_key/fault_skew/fault_counts,
-#       core/state.py) join the carry, and writes became atomic
-#       (tmp-then-os.replace); a version-3 checkpoint is three leaves short
-#       and errors here rather than misalign every leaf after delay_state
-#   5 — PR-4 snapshot-supervisor leaves (snap_epoch/snap_deadline/
-#       snap_retries/snap_initiator/snap_failed/snap_done_time +
-#       stale_markers, core/state.py) join the carry and fault_counts
-#       widens to [7] (marker-plane classes); a version-4 checkpoint is
-#       seven leaves short with a mis-shaped fault_counts, so it errors
-#       here rather than misdecode
-#   6 — PR-6 streaming-engine leaves (job_id/prog_cursor/admit_tick,
-#       core/state.py): per-lane job identity joins the carry so a
-#       streaming run (parallel/batch.run_stream) checkpointed mid-queue
-#       resumes its admission state bit-exactly; a version-5 checkpoint is
-#       three leaves short and errors here rather than misalign every
-#       leaf after stale_markers
-#   7 — PR-7 flight-recorder leaves (tr_meta/tr_data/tr_tick/tr_count/
-#       tr_on, core/state.py): the per-lane device trace ring joins the
-#       carry so a kill mid-run resumes with its event history (and its
-#       dropped-events accounting) bit-exact; a version-6 checkpoint is
-#       five leaves short and errors here rather than misalign every
-#       leaf after admit_tick
-_FORMAT_VERSION = 7
+# The version history table lives beside the state plan it versions:
+# core/state.py CHECKPOINT_FORMAT_HISTORY, one row per breaking layout
+# change with what changed and why an older file errors instead of
+# misaligning leaves. This binding is literal-free on purpose — bumping
+# the format means appending a history row there, and staticcheck's
+# ckpt-version-literal rule flags any restated version literal here.
+_FORMAT_VERSION = CHECKPOINT_FORMAT_VERSION
 # every layout change so far has been breaking (leaves added or reshaped),
 # so exactly one version is live; kept as a range so a future
 # backward-compatible revision can widen the floor without touching the
@@ -135,7 +109,7 @@ def load_state(path: str, like: DenseState) -> Tuple[DenseState, dict]:
                     f"checkpoint {path}: unsupported format version "
                     f"{version} (this build reads the supported version "
                     f"range v{_MIN_SUPPORTED_VERSION}..v{_FORMAT_VERSION}; "
-                    f"see the version history in utils/checkpoint.py)")
+                    f"see CHECKPOINT_FORMAT_HISTORY in core/state.py)")
             leaves = [z[f"leaf_{i}"] for i in range(header["num_leaves"])]
     except CheckpointError:
         raise

@@ -30,6 +30,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# NOTE: do NOT enable jax_compilation_cache_dir here. XLA:CPU executable
+# deserialization segfaults on this jaxlib (hard crash mid-suite in a
+# cache-hit pjit call), so the persistent compile cache is a correctness
+# hazard on the CPU mesh, not a speedup.
+
 assert jax.devices()[0].platform == "cpu", (
     "tests must run on the virtual CPU mesh, not the real TPU chip")
 assert len(jax.devices()) >= 8, (

@@ -11,9 +11,14 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# ~72 s on the 1-core CI box — far past the ~30 s tier-1 per-test budget
+# (the 870 s wall can no longer absorb it); full passes run the battery
+@pytest.mark.slow
 def test_chaos_smoke_battery_green():
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py")],

@@ -165,6 +165,7 @@ def test_v5_roundtrip_carries_supervisor_leaves(tmp_path):
 # ---- kill-and-resume bit-exactness (python API) ------------------------
 
 
+@pytest.mark.slow  # ~18 s; the cli kill-resume bit-exact test stays tier-1
 def test_kill_and_resume_bit_exact_with_adversary(tmp_path):
     adversary = JaxFaults(5, drop_rate=0.03, dup_rate=0.03,
                           jitter_rate=0.03)

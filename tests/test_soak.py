@@ -9,11 +9,16 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CASES = 6
 
 
+# ~74 s on the 1-core CI box — far past the ~30 s tier-1 per-test budget
+# (the 870 s wall can no longer absorb it); full passes run the battery
+@pytest.mark.slow
 def test_soak_all_engines():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "soak.py"),

@@ -1,0 +1,96 @@
+"""tools/staticcheck: the AST lint plane and the fingerprint registry.
+
+The AST tests are jax-free and near-instant; the jaxpr plane traces real
+entries and is marked slow (the tier-1 gate runs -m 'not slow').
+"""
+
+import json
+import os
+
+import pytest
+
+from tools.staticcheck import apply_allowlist
+from tools.staticcheck import ast_lint
+from tools.staticcheck.jaxpr_audit import load_registry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ast_plane_clean_on_tree():
+    # the shipped tree must satisfy its own structural invariants
+    # (err-bit registry, knob pattern, ckpt history, scatter modes)
+    kept, _allowed = apply_allowlist(ast_lint.lint_tree(REPO_ROOT))
+    assert kept == [], [v.to_dict() for v in kept]
+
+
+def test_err_bit_registry_rejects_non_power_of_two():
+    sources = {ast_lint.STATE_PATH: (
+        "ERR_A = 1\n"
+        "ERR_B = 3\n"
+        "ERROR_REGISTRY = ((\"ERR_A\", ERR_A, \"a\"), (\"ERR_B\", ERR_B, \"b\"))\n"
+        "NUM_ERROR_BITS = len(ERROR_REGISTRY)\n"
+        "ERROR_NAMES = {r[1]: r[2] for r in ERROR_REGISTRY}\n"
+        "ERROR_BIT_NAMES = {r[1]: r[0] for r in ERROR_REGISTRY}\n"
+    )}
+    vs = ast_lint.check_error_bits(sources)
+    assert any("not a power of two" in v.detail for v in vs), \
+        [v.detail for v in vs]
+
+
+def test_err_bit_registry_accepts_tuple_and_call_rows():
+    # rows as bare tuples and as constructor calls must both parse
+    for row_b in ("(\"ERR_B\", ERR_B, \"b\")", "ErrorBit(\"ERR_B\", ERR_B, \"b\")"):
+        sources = {ast_lint.STATE_PATH: (
+            "ERR_A = 1\n"
+            "ERR_B = 2\n"
+            "ERROR_REGISTRY = ((\"ERR_A\", ERR_A, \"a\"), " + row_b + ")\n"
+            "NUM_ERROR_BITS = len(ERROR_REGISTRY)\n"
+            "ERROR_NAMES = {r[1]: r[2] for r in ERROR_REGISTRY}\n"
+            "ERROR_BIT_NAMES = {r[1]: r[0] for r in ERROR_REGISTRY}\n"
+        )}
+        assert ast_lint.check_error_bits(sources) == []
+
+
+def test_err_bit_registry_catches_name_bit_mismatch():
+    sources = {ast_lint.STATE_PATH: (
+        "ERR_A = 1\n"
+        "ERR_B = 2\n"
+        "ERROR_REGISTRY = ((\"ERR_A\", ERR_B, \"a\"), (\"ERR_B\", ERR_B, \"b\"))\n"
+        "NUM_ERROR_BITS = len(ERROR_REGISTRY)\n"
+        "ERROR_NAMES = {r[1]: r[2] for r in ERROR_REGISTRY}\n"
+        "ERROR_BIT_NAMES = {r[1]: r[0] for r in ERROR_REGISTRY}\n"
+    )}
+    vs = ast_lint.check_error_bits(sources)
+    assert any("name and bit disagree" in v.detail for v in vs), \
+        [v.detail for v in vs]
+
+
+def test_registry_loader_reads_legacy_and_schema2(tmp_path):
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"k": "abc"}))
+    entries, ver = load_registry(str(legacy))
+    assert entries == {"k": "abc"} and ver is None
+
+    v2 = tmp_path / "v2.json"
+    v2.write_text(json.dumps(
+        {"schema": 2, "jax": "9.9.9", "entries": {"k": "def"}}))
+    entries, ver = load_registry(str(v2))
+    assert entries == {"k": "def"} and ver == "9.9.9"
+
+    missing, ver = load_registry(str(tmp_path / "nope.json"))
+    assert missing == {} and ver is None
+
+
+def test_shipped_registry_is_schema2_and_version_stamped():
+    entries, ver = load_registry()
+    assert entries, "fingerprints.json has no entries"
+    assert ver, "fingerprints.json does not record the jax version"
+
+
+@pytest.mark.slow
+def test_jaxpr_fast_plane_clean():
+    from tools.staticcheck import jaxpr_audit
+    vs, audited, _ = jaxpr_audit.audit("fast", check_fingerprints=True)
+    kept, _allowed = apply_allowlist(vs)
+    assert kept == [], [v.to_dict() for v in kept]
+    assert len(audited) == 5

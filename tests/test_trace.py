@@ -105,6 +105,7 @@ def test_trace_wraparound_and_dropped_count(small_traced):
     assert capped.trace.events == all_events[-4:]
 
 
+@pytest.mark.slow  # ~27 s; cheaper roundtrips in test_recovery stay tier-1
 def test_checkpoint_v7_ring_roundtrip(tmp_path):
     """Kill -> resume through a checkpoint carries the ring bit-exactly:
     a storm split in two with a save/load between the chunks finishes with

@@ -1,0 +1,91 @@
+"""clsim-audit: two-plane static analyzer for the simulator.
+
+Plane 1 (``jaxpr_audit``) traces every public jitted entry point across the
+engine-knob matrix (``chandy_lamport_tpu.config.ENGINE_KNOBS`` x
+exact_impl x scheduler x faults x trace) with ``jax.make_jaxpr`` and audits
+the traces themselves: dtype discipline, constant-capture budget, donation,
+host-callback leaks, collective well-formedness, and a lowering-fingerprint
+registry (``fingerprints.json``) that fails when a trace changes without
+being regenerated.
+
+Plane 2 (``ast_lint``) runs custom AST rules over the package source:
+error-bit registry coverage, checkpoint-format single-sourcing, the
+engine-knob pattern (resolver + CLI flag + bench row per knob), traced-module
+purity (no ``time``/``random``/``np.random``), and explicit ``mode=`` on
+sharded-plane scatters.
+
+Run ``python -m tools.staticcheck`` from the repo root; it writes a JSON
+violations report and exits nonzero on any non-allowlisted violation.
+Intentional exceptions live in ``allowlist.py`` with one-line reasons.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+from typing import Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule failure: ``rule`` is the stable rule id, ``where`` locates it
+    (``path:line`` for AST rules, the entry key for jaxpr rules), ``detail``
+    says what was found and what the rule wanted instead."""
+
+    rule: str
+    where: str
+    detail: str
+
+    def key(self) -> str:
+        return f"{self.rule}@{self.where}"
+
+    def to_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+def apply_allowlist(violations: Sequence[Violation]):
+    """Split ``violations`` into (kept, allowed) against ``allowlist.ALLOW``.
+
+    A violation is allowed when some entry's rule matches exactly and its
+    ``where`` pattern fnmatches the violation's ``where``. Allowed
+    violations still appear in the report (with their reasons) so the
+    allowlist is auditable, but do not affect the exit code.
+    """
+    from tools.staticcheck.allowlist import ALLOW
+
+    kept: List[Violation] = []
+    allowed: List[dict] = []
+    for v in violations:
+        reason: Optional[str] = None
+        for a in ALLOW:
+            if a.rule == v.rule and fnmatch.fnmatch(v.where, a.where):
+                reason = a.reason
+                break
+        if reason is None:
+            kept.append(v)
+        else:
+            allowed.append({**v.to_dict(), "allowed_because": reason})
+    return kept, allowed
+
+
+def build_report(violations: Sequence[Violation], allowed: Sequence[dict],
+                 *, entries_audited: Sequence[str] = (),
+                 mode: str = "full", notes: Sequence[str] = ()) -> dict:
+    """Assemble the JSON report ``__main__``/``cli audit`` emit."""
+    report = {
+        "tool": "clsim-staticcheck",
+        "mode": mode,
+        "entries_audited": list(entries_audited),
+        "num_violations": len(violations),
+        "violations": [v.to_dict() for v in violations],
+        "allowed": list(allowed),
+        "clean": not violations,
+    }
+    if notes:
+        report["notes"] = list(notes)
+    return report
+
+
+def report_to_json(report: dict) -> str:
+    return json.dumps(report, indent=2, sort_keys=False)

@@ -1,0 +1,86 @@
+"""``python -m tools.staticcheck`` — run both analysis planes, write a JSON
+violations report, exit nonzero on any non-allowlisted violation.
+
+The jaxpr plane needs the canonical audit environment (CPU backend, 8 host
+devices, x64) pinned BEFORE jax is imported, so this module sets it up
+first thing — same contract as tests/conftest.py and cli.py, which is what
+keeps the fingerprint registry agreeing between the CLI and the suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # repo root on sys.path so `python tools/staticcheck/__main__.py` works
+    # too (the -m form from the repo root needs nothing)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
+    from tools.staticcheck import (
+        apply_allowlist,
+        build_report,
+        report_to_json,
+    )
+    from tools.staticcheck import ast_lint, jaxpr_audit
+
+    ap = argparse.ArgumentParser(
+        prog="tools.staticcheck",
+        description="clsim-audit: jaxpr trace auditor + AST lint")
+    ap.add_argument("--plane", choices=("jaxpr", "ast", "both"),
+                    default="both")
+    ap.add_argument("--fast", action="store_true",
+                    help="jaxpr plane: one arm per engine axis instead of "
+                         "the full knob matrix")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the report here (default: stdout only)")
+    ap.add_argument("--fingerprints-update", action="store_true",
+                    help="re-register lowering fingerprints for every "
+                         "entry traced in this run")
+    ap.add_argument("--no-fingerprints", action="store_true",
+                    help="skip the fingerprint registry check")
+    args = ap.parse_args(argv)
+
+    # only the jaxpr plane needs jax (and the pinned audit env) at all —
+    # a lint-only run must stay import-light and never mutate XLA env vars
+    if args.plane in ("jaxpr", "both"):
+        jaxpr_audit.ensure_env()
+
+    violations = []
+    audited = []
+    notes = []
+    mode = "fast" if args.fast else "full"
+    if args.plane in ("ast", "both"):
+        violations.extend(ast_lint.lint_tree(root))
+    if args.plane in ("jaxpr", "both"):
+        vs, keys, _fps = jaxpr_audit.audit(
+            mode,
+            check_fingerprints=not args.no_fingerprints,
+            update_fingerprints=args.fingerprints_update)
+        violations.extend(vs)
+        audited.extend(keys)
+        if jaxpr_audit._LAST_REGISTRY_NOTE:
+            notes.append(jaxpr_audit._LAST_REGISTRY_NOTE)
+
+    kept, allowed = apply_allowlist(violations)
+    report = build_report(kept, allowed, entries_audited=audited, mode=mode,
+                          notes=notes)
+    text = report_to_json(report)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    print(text)
+    if kept:
+        print(f"staticcheck: {len(kept)} violation(s)", file=sys.stderr)
+        return 1
+    print("staticcheck: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Intentional exceptions to staticcheck rules, each with a one-line reason.
+
+An entry suppresses violations whose ``rule`` matches exactly and whose
+``where`` matches the fnmatch pattern. Allowed violations still show up in
+the JSON report under ``allowed`` (with the reason), so every suppression
+stays auditable; they just don't fail the run. Keep this list short — a
+grown allowlist is the rule set rotting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Allow:
+    rule: str
+    where: str  # fnmatch pattern against Violation.where
+    reason: str
+
+
+ALLOW: Tuple[Allow, ...] = (
+    Allow(
+        rule="ckpt-version-literal",
+        where="tests/test_recovery.py:*",
+        reason="deliberately stale version via monkeypatch to prove the "
+               "unsupported-version error path",
+    ),
+    Allow(
+        rule="ckpt-version-literal",
+        where="tests/test_stream.py:*",
+        reason="deliberately bogus version via monkeypatch to prove "
+               "load-time rejection of future checkpoints",
+    ),
+)

@@ -1,0 +1,519 @@
+"""Plane 2: custom AST rules over the package (and test) sources.
+
+Every rule takes ``sources: {repo-relative path: source text}`` and returns
+a list of Violations, so tests can feed synthetic violating sources without
+touching the tree. ``lint_tree(root)`` loads the real files and runs the
+whole rule set.
+
+Rules (ids are stable; the README rule table documents them):
+
+  err-bit-registry    ERR_* constants in core/state.py are distinct powers
+                      of two with no gaps, and every one has exactly one
+                      ERROR_REGISTRY decode row (and vice versa);
+                      NUM_ERROR_BITS is ``len(ERROR_REGISTRY)``, not a
+                      second literal.
+  por-width           graphshard's ``_por`` error-plane reduction derives
+                      its bit width from NUM_ERROR_BITS — a hardcoded
+                      ``arange(<int>)`` silently drops newly added bits.
+  ckpt-version-literal  checkpoint format version literals live ONLY in the
+                      core/state.py history table; any other assignment or
+                      monkeypatch.setattr of a ``*FORMAT_VERSION*`` name to
+                      an int literal is flagged (test sites that prove the
+                      rejection paths are allowlisted).
+  ckpt-history        CHECKPOINT_FORMAT_HISTORY rows are consecutive
+                      versions from 1 and CHECKPOINT_FORMAT_VERSION is
+                      bound to the last row, not re-stated.
+  knob-pattern        every ENGINE_KNOBS knob has a ``resolve_<knob>``
+                      function somewhere in the package, a ``--<knob>`` CLI
+                      flag (cli.py or bench.py), and a bench worker-row
+                      field; SimConfig.__post_init__ validates against the
+                      table rather than inline tuples.
+  traced-import       modules whose code runs under jit must not import
+                      ``time``/``random`` or touch ``np.random`` — host
+                      RNG/clock in a traced file is either dead weight or a
+                      nondeterminism bug waiting to be traced in.
+  scatter-mode        ``.at[...].add/.set/...`` on the sharded planes in
+                      parallel/graphshard.py must pass an explicit
+                      ``mode=``: the default ("fill_or_drop"-ish semantics
+                      differing by op) hides out-of-bounds intent and costs
+                      a select XLA can't always elide.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.staticcheck import Violation
+
+STATE_PATH = "chandy_lamport_tpu/core/state.py"
+CONFIG_PATH = "chandy_lamport_tpu/config.py"
+GRAPHSHARD_PATH = "chandy_lamport_tpu/parallel/graphshard.py"
+CLI_PATH = "chandy_lamport_tpu/cli.py"
+BENCH_PATH = "chandy_lamport_tpu/bench.py"
+
+# modules whose function bodies are traced into jaxprs (directly or via the
+# kernels/runners) — host clock/RNG imports are banned here
+TRACED_MODULES = (
+    "chandy_lamport_tpu/core/state.py",
+    "chandy_lamport_tpu/ops/tick.py",
+    "chandy_lamport_tpu/ops/delay_jax.py",
+    "chandy_lamport_tpu/kernels/queue.py",
+    "chandy_lamport_tpu/kernels/segment.py",
+    "chandy_lamport_tpu/models/faults.py",
+    "chandy_lamport_tpu/parallel/batch.py",
+    "chandy_lamport_tpu/parallel/graphshard.py",
+    "chandy_lamport_tpu/utils/tracing.py",
+)
+
+_SCATTER_ATTRS = {"add", "set", "mul", "min", "max", "subtract", "apply",
+                  "divide", "power"}
+
+
+def _parse(sources: Dict[str, str], path: str) -> Optional[ast.Module]:
+    src = sources.get(path)
+    if src is None:
+        return None
+    return ast.parse(src, filename=path)
+
+
+def _assign_targets(node: ast.stmt) -> List[str]:
+    if isinstance(node, ast.Assign):
+        return [t.id for t in node.targets if isinstance(t, ast.Name)]
+    if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+        return [node.target.id]
+    return []
+
+
+def _assign_value(node: ast.stmt):
+    return node.value if isinstance(node, (ast.Assign, ast.AnnAssign)) else None
+
+
+# ---------------------------------------------------------------------------
+# err-bit-registry
+
+
+def check_error_bits(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    tree = _parse(sources, STATE_PATH)
+    if tree is None:
+        return [Violation("err-bit-registry", STATE_PATH,
+                          "core/state.py not found in lint input")]
+
+    consts: Dict[str, Tuple[int, int]] = {}  # name -> (value, lineno)
+    registry_rows: List[Tuple[str, object, int]] = []
+    num_bits_value: Optional[ast.expr] = None
+    names_from_registry = False
+
+    for node in tree.body:
+        value = _assign_value(node)
+        for name in _assign_targets(node):
+            if name.startswith("ERR_") and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, int):
+                consts[name] = (value.value, node.lineno)
+            elif name == "ERROR_REGISTRY":
+                for elt in getattr(value, "elts", []):
+                    # rows may be constructor calls (ErrorBit(...)) or bare
+                    # tuples, mirroring the CHECKPOINT_FORMAT_HISTORY parser
+                    row = (elt.args if isinstance(elt, ast.Call)
+                           else elt.elts if isinstance(elt, ast.Tuple)
+                           else [])
+                    if row:
+                        row_name = (row[0].value
+                                    if isinstance(row[0], ast.Constant)
+                                    else None)
+                        bit = row[1] if len(row) > 1 else None
+                        registry_rows.append((row_name, bit, elt.lineno))
+            elif name == "NUM_ERROR_BITS":
+                num_bits_value = value
+            elif name in ("ERROR_NAMES", "ERROR_BIT_NAMES"):
+                if any(isinstance(n, ast.Name) and n.id == "ERROR_REGISTRY"
+                       for n in ast.walk(value)):
+                    names_from_registry = True
+
+    for name, (v, ln) in sorted(consts.items(), key=lambda kv: kv[1][0]):
+        if v <= 0 or v & (v - 1):
+            out.append(Violation(
+                "err-bit-registry", f"{STATE_PATH}:{ln}",
+                f"{name} = {v} is not a power of two — error bits must "
+                f"OR together losslessly"))
+    by_value: Dict[int, List[str]] = {}
+    for name, (v, _) in consts.items():
+        by_value.setdefault(v, []).append(name)
+    for v, names in sorted(by_value.items()):
+        if len(names) > 1:
+            ln = consts[names[1]][1]
+            out.append(Violation(
+                "err-bit-registry", f"{STATE_PATH}:{ln}",
+                f"duplicate error bit {v}: {sorted(names)} — decode cannot "
+                f"distinguish them"))
+    want = {1 << i for i in range(len(by_value))}
+    have = set(by_value)
+    if consts and have != want and not any(
+            v <= 0 or v & (v - 1) for v in have) and len(by_value) == len(consts):
+        out.append(Violation(
+            "err-bit-registry", STATE_PATH,
+            f"error bits have gaps: {sorted(have)} != contiguous "
+            f"{sorted(want)} — _por and the decode tables assume a dense "
+            f"low-bit plane"))
+
+    if not registry_rows:
+        out.append(Violation(
+            "err-bit-registry", STATE_PATH,
+            "no ERROR_REGISTRY table — decode strings must live beside "
+            "their bits in one declarative registry"))
+    else:
+        row_names = [r[0] for r in registry_rows]
+        for row_name, bit, ln in registry_rows:
+            if row_name not in consts:
+                out.append(Violation(
+                    "err-bit-registry", f"{STATE_PATH}:{ln}",
+                    f"ERROR_REGISTRY row {row_name!r} has no matching ERR_ "
+                    f"constant"))
+            elif isinstance(bit, ast.Name) and bit.id != row_name:
+                out.append(Violation(
+                    "err-bit-registry", f"{STATE_PATH}:{ln}",
+                    f"ERROR_REGISTRY row {row_name!r} binds bit {bit.id} — "
+                    f"name and bit disagree"))
+            elif isinstance(bit, ast.Constant) and \
+                    bit.value != consts[row_name][0]:
+                out.append(Violation(
+                    "err-bit-registry", f"{STATE_PATH}:{ln}",
+                    f"ERROR_REGISTRY row {row_name!r} restates bit "
+                    f"{bit.value}, but {row_name} = {consts[row_name][0]}"))
+        missing = sorted(set(consts) - set(row_names))
+        if missing:
+            out.append(Violation(
+                "err-bit-registry", STATE_PATH,
+                f"ERR_ constants with no ERROR_REGISTRY decode row: "
+                f"{missing} — decode_errors would silently drop them"))
+        dup_rows = sorted({n for n in row_names if row_names.count(n) > 1})
+        if dup_rows:
+            out.append(Violation(
+                "err-bit-registry", STATE_PATH,
+                f"duplicate ERROR_REGISTRY rows: {dup_rows}"))
+
+    if num_bits_value is None or not (
+            isinstance(num_bits_value, ast.Call)
+            and isinstance(num_bits_value.func, ast.Name)
+            and num_bits_value.func.id == "len"):
+        out.append(Violation(
+            "err-bit-registry", STATE_PATH,
+            "NUM_ERROR_BITS must be len(ERROR_REGISTRY), not an independent "
+            "literal that can drift"))
+    if registry_rows and not names_from_registry:
+        out.append(Violation(
+            "err-bit-registry", STATE_PATH,
+            "ERROR_NAMES/ERROR_BIT_NAMES must be derived from "
+            "ERROR_REGISTRY, not hand-written dicts"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# por-width
+
+
+def check_por_width(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    tree = _parse(sources, GRAPHSHARD_PATH)
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_por":
+            uses_num_bits = any(
+                isinstance(n, ast.Name) and n.id == "NUM_ERROR_BITS"
+                for n in ast.walk(node))
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) or not call.args:
+                    continue
+                fn = call.func
+                is_arange = (isinstance(fn, ast.Attribute)
+                             and fn.attr == "arange") or \
+                            (isinstance(fn, ast.Name) and fn.id == "arange")
+                if is_arange and isinstance(call.args[0], ast.Constant):
+                    out.append(Violation(
+                        "por-width", f"{GRAPHSHARD_PATH}:{call.lineno}",
+                        f"_por hardcodes the error-plane width "
+                        f"({call.args[0].value}); a new ERR_ bit would be "
+                        f"silently dropped — use NUM_ERROR_BITS"))
+            if not uses_num_bits:
+                out.append(Violation(
+                    "por-width", f"{GRAPHSHARD_PATH}:{node.lineno}",
+                    "_por does not reference NUM_ERROR_BITS — the bit-plane "
+                    "width must track the registry"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ckpt-version-literal + ckpt-history
+
+
+def check_ckpt_versions(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    for path, src in sorted(sources.items()):
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            targets = _assign_targets(node)
+            value = _assign_value(node)
+            for name in targets:
+                if "FORMAT_VERSION" in name and \
+                        isinstance(value, ast.Constant) and \
+                        isinstance(value.value, int) and path != STATE_PATH:
+                    out.append(Violation(
+                        "ckpt-version-literal", f"{path}:{node.lineno}",
+                        f"{name} = {value.value}: checkpoint version "
+                        f"literals live only in the core/state.py history "
+                        f"table — bind from CHECKPOINT_FORMAT_VERSION"))
+            if isinstance(node, ast.Call):
+                fn = node.func
+                is_setattr = (isinstance(fn, ast.Name) and
+                              fn.id == "setattr") or \
+                             (isinstance(fn, ast.Attribute) and
+                              fn.attr == "setattr")
+                if is_setattr and any(
+                        isinstance(a, ast.Constant) and
+                        isinstance(a.value, str) and
+                        "FORMAT_VERSION" in a.value for a in node.args):
+                    out.append(Violation(
+                        "ckpt-version-literal", f"{path}:{node.lineno}",
+                        "setattr of a *FORMAT_VERSION* name — version "
+                        "overrides outside the state.py table need an "
+                        "allowlist reason"))
+
+    tree = _parse(sources, STATE_PATH)
+    if tree is None:
+        return out
+    history_rows: List[Tuple[int, int]] = []  # (version, lineno)
+    version_value: Optional[ast.expr] = None
+    version_line = 0
+    for node in tree.body:
+        value = _assign_value(node)
+        for name in _assign_targets(node):
+            if name == "CHECKPOINT_FORMAT_HISTORY":
+                for elt in getattr(value, "elts", []):
+                    if isinstance(elt, ast.Tuple) and elt.elts and \
+                            isinstance(elt.elts[0], ast.Constant):
+                        history_rows.append((elt.elts[0].value, elt.lineno))
+            elif name == "CHECKPOINT_FORMAT_VERSION":
+                version_value, version_line = value, node.lineno
+    if not history_rows:
+        out.append(Violation(
+            "ckpt-history", STATE_PATH,
+            "no CHECKPOINT_FORMAT_HISTORY table in core/state.py"))
+        return out
+    for i, (v, ln) in enumerate(history_rows):
+        if v != i + 1:
+            out.append(Violation(
+                "ckpt-history", f"{STATE_PATH}:{ln}",
+                f"history row {i} has version {v}, expected {i + 1} — "
+                f"versions are consecutive from 1 so the supported-range "
+                f"error message stays truthful"))
+            break
+    if isinstance(version_value, ast.Constant):
+        out.append(Violation(
+            "ckpt-history", f"{STATE_PATH}:{version_line}",
+            f"CHECKPOINT_FORMAT_VERSION = {version_value.value} restates "
+            f"the number — bind it to the last history row"))
+    elif version_value is not None and not any(
+            isinstance(n, ast.Name) and n.id == "CHECKPOINT_FORMAT_HISTORY"
+            for n in ast.walk(version_value)):
+        out.append(Violation(
+            "ckpt-history", f"{STATE_PATH}:{version_line}",
+            "CHECKPOINT_FORMAT_VERSION is not derived from "
+            "CHECKPOINT_FORMAT_HISTORY"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# knob-pattern
+
+
+def check_knob_pattern(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    tree = _parse(sources, CONFIG_PATH)
+    if tree is None:
+        return out
+    knobs: List[str] = []
+    for node in tree.body:
+        value = _assign_value(node)
+        if "ENGINE_KNOBS" in _assign_targets(node) and \
+                isinstance(value, ast.Dict):
+            knobs = [k.value for k in value.keys
+                     if isinstance(k, ast.Constant)]
+    if not knobs:
+        return [Violation(
+            "knob-pattern", CONFIG_PATH,
+            "no ENGINE_KNOBS table in config.py — knob spellings must be "
+            "declarative")]
+
+    resolvers = set()
+    for path, src in sources.items():
+        if not path.startswith("chandy_lamport_tpu/"):
+            continue
+        try:
+            t = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(t):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name.startswith("resolve_"):
+                resolvers.add(node.name)
+
+    flag_strings = set()
+    bench_row_keys = set()
+    for path in (CLI_PATH, BENCH_PATH):
+        t = _parse(sources, path)
+        if t is None:
+            continue
+        for node in ast.walk(t):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                flag_strings.add(node.value)
+            if path == BENCH_PATH and isinstance(node, ast.Dict):
+                bench_row_keys.update(
+                    k.value for k in node.keys
+                    if isinstance(k, ast.Constant) and
+                    isinstance(k.value, str))
+
+    for knob in knobs:
+        if f"resolve_{knob}" not in resolvers:
+            out.append(Violation(
+                "knob-pattern", CONFIG_PATH,
+                f"knob {knob!r} has no resolve_{knob}() — every knob needs "
+                f"one place that turns 'auto' into a concrete engine"))
+        flag = "--" + knob.replace("_", "-")
+        if flag not in flag_strings:
+            out.append(Violation(
+                "knob-pattern", CONFIG_PATH,
+                f"knob {knob!r} has no {flag} flag in cli.py or bench.py"))
+        if knob not in bench_row_keys:
+            out.append(Violation(
+                "knob-pattern", CONFIG_PATH,
+                f"knob {knob!r} is not stamped into any bench.py worker "
+                f"row — sweep results would not record which engine ran"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "__post_init__":
+            if not any(isinstance(n, ast.Name) and n.id == "ENGINE_KNOBS"
+                       for n in ast.walk(node)):
+                out.append(Violation(
+                    "knob-pattern", f"{CONFIG_PATH}:{node.lineno}",
+                    "SimConfig.__post_init__ validates knobs without "
+                    "consulting ENGINE_KNOBS — inline tuples drift"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traced-import
+
+
+def check_traced_imports(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    for path in TRACED_MODULES:
+        tree = _parse(sources, path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root in ("time", "random"):
+                        out.append(Violation(
+                            "traced-import", f"{path}:{node.lineno}",
+                            f"import {alias.name} in a traced module — "
+                            f"host clock/RNG must stay out of jitted code"))
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root in ("time", "random"):
+                    out.append(Violation(
+                        "traced-import", f"{path}:{node.lineno}",
+                        f"from {node.module} import ... in a traced module"))
+                if root == "numpy" and any(
+                        a.name == "random" for a in node.names):
+                    out.append(Violation(
+                        "traced-import", f"{path}:{node.lineno}",
+                        "numpy.random in a traced module — nondeterministic "
+                        "under retrace"))
+            elif isinstance(node, ast.Attribute) and node.attr == "random" \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in ("np", "numpy"):
+                out.append(Violation(
+                    "traced-import", f"{path}:{node.lineno}",
+                    "np.random use in a traced module — nondeterministic "
+                    "under retrace; thread a jax PRNG key instead"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scatter-mode
+
+
+def check_scatter_mode(sources: Dict[str, str]) -> List[Violation]:
+    """``x.at[idx].add(v)`` without ``mode=`` in graphshard.py. The AST
+    shape is Call(func=Attribute(value=Subscript(value=Attribute(attr='at')),
+    attr='add'))."""
+    out: List[Violation] = []
+    tree = _parse(sources, GRAPHSHARD_PATH)
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SCATTER_ATTRS
+                and isinstance(node.func.value, ast.Subscript)
+                and isinstance(node.func.value.value, ast.Attribute)
+                and node.func.value.value.attr == "at"):
+            continue
+        if not any(kw.arg == "mode" for kw in node.keywords):
+            out.append(Violation(
+                "scatter-mode", f"{GRAPHSHARD_PATH}:{node.lineno}",
+                f".at[...].{node.func.attr}(...) without explicit mode= on "
+                f"a sharded plane — state the out-of-bounds contract "
+                f"(promise_in_bounds for pre-clipped indices, drop for "
+                f"sentinel targets)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+ALL_RULES = (
+    check_error_bits,
+    check_por_width,
+    check_ckpt_versions,
+    check_knob_pattern,
+    check_traced_imports,
+    check_scatter_mode,
+)
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Violation]:
+    out: List[Violation] = []
+    for rule in ALL_RULES:
+        out.extend(rule(sources))
+    return out
+
+
+def load_tree(root: str) -> Dict[str, str]:
+    """Collect the lint input: every .py under chandy_lamport_tpu/ and
+    tests/, keyed by repo-relative path."""
+    sources: Dict[str, str] = {}
+    for top in ("chandy_lamport_tpu", "tests"):
+        base = os.path.join(root, top)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, "r", encoding="utf-8") as f:
+                    sources[rel] = f.read()
+    return sources
+
+
+def lint_tree(root: str) -> List[Violation]:
+    return lint_sources(load_tree(root))

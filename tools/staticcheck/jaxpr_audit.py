@@ -1,0 +1,674 @@
+"""Plane 1: trace-level audit of every public jitted entry point.
+
+Each entry builder constructs a tiny instance of one jitted surface —
+TickKernel ticks across the knob matrix, the batched storm step, the
+streaming step, the graph-sharded dispatch, the Pallas kernels under
+interpret=True — and returns the callable plus example arguments. The
+audit traces it with ``jax.make_jaxpr`` and checks the trace itself:
+
+  f64-in-trace        no float64 aval anywhere (weak-typed promotion bugs
+                      surface here long before a TPU run fails on them)
+  i64-in-trace        no int64/uint64 aval: the state plan is i32/u32 and
+                      an unintended promotion doubles HBM silently
+  state-leaf-dtype    output state leaves are int32/uint32/bool only
+  const-capture       total jaxpr consts bytes under the per-entry budget
+                      (the failure mode that broke 8k-node remote
+                      compilation: GB-scale incidence constants in HLO)
+  donation            entries built with donate_argnums actually alias
+                      their carry (``tf.aliasing_output`` in the lowering;
+                      a donation silently dropped = 2x state HBM)
+  host-callback       no debug_callback/io_callback/pure_callback in hot
+                      paths — a stray jax.debug.print syncs every step
+  ppermute-bijection  every ppermute permutation is a bijection (a dropped
+                      or duplicated shard lane deadlocks the halo ring)
+  collective-axis     every named collective's axis exists in the entry's
+                      mesh (and entries without a mesh trace no named
+                      collectives at all)
+  fingerprint         sha256 of the normalized trace structure (primitive
+                      names, aval signatures, value-like params, consts
+                      signature) matches fingerprints.json; fails when a
+                      trace changes without regeneration
+                      (``--fingerprints-update``); skipped with a report
+                      note when the registry's recorded jax version
+                      differs from the running one
+
+Callers must set up the audit environment BEFORE importing jax (see
+``ensure_env``): CPU backend, 8 host devices, x64 enabled — the same
+canonical environment conftest.py and cli.py pin, so fingerprints agree
+between the CLI and the test suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.staticcheck import Violation
+
+FINGERPRINTS_PATH = os.path.join(os.path.dirname(__file__),
+                                 "fingerprints.json")
+
+# set by audit() when the registry's recorded jax version does not match
+# the running one and the fingerprint comparison was therefore skipped;
+# surfaced in the JSON report so a skipped gate is visible, not silent
+_LAST_REGISTRY_NOTE: Optional[str] = None
+
+# primitives that round-trip through the host: forbidden in every audited
+# entry (the flight recorder exists precisely so hot paths never need them)
+HOST_CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "outside_call", "host_callback_call", "debug_print",
+})
+
+# eqn params that carry collective axis names
+_AXIS_PARAM_KEYS = ("axis_name", "axes", "axis_index_groups_axis")
+
+DEFAULT_CONST_BUDGET = 4 << 20  # bytes; audit graphs are tiny, so generous
+
+
+def ensure_env() -> None:
+    """Pin the canonical audit environment. Must run before jax is first
+    imported; no-op (with a check) afterwards."""
+    import sys
+    if "jax" in sys.modules:
+        import jax
+        if jax.default_backend() not in ("cpu",):
+            raise RuntimeError(
+                "staticcheck must run on the CPU backend (jax was already "
+                f"imported with backend {jax.default_backend()!r})")
+        jax.config.update("jax_enable_x64", True)
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+
+@dataclasses.dataclass
+class Entry:
+    """One audited jitted surface. ``fn``/``args`` feed make_jaxpr;
+    ``jit_fn`` (when set) is the user-facing jitted callable, lowered to
+    verify donation of ``donated`` argnums. ``axis_names`` are the mesh
+    axes named collectives may reference (empty = none allowed).
+    ``state_out`` applies the int32/uint32/bool whitelist to every output
+    leaf (entries returning DenseState-only pytrees)."""
+
+    key: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    jit_fn: Optional[Callable] = None
+    donated: Tuple[int, ...] = ()
+    axis_names: FrozenSet[str] = frozenset()
+    state_out: bool = True
+    const_budget: int = DEFAULT_CONST_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+
+
+def _delay(kind: str = "hash"):
+    from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, make_fast_delay
+    if kind == "fixed":
+        return FixedJaxDelay(2)
+    return make_fast_delay("hash", 7)
+
+
+def _cfg(**overrides):
+    from chandy_lamport_tpu.config import SimConfig
+    return SimConfig.for_workload(snapshots=2, max_recorded=32, **overrides)
+
+
+def _tick_topo(n: int):
+    from chandy_lamport_tpu.core.state import DenseTopology
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    return DenseTopology(ring_topology(n, tokens=16))
+
+
+def _faults():
+    from chandy_lamport_tpu.models.faults import JaxFaults
+    return JaxFaults(3, drop_rate=0.05)
+
+
+def _trace():
+    from chandy_lamport_tpu.utils.tracing import JaxTrace
+    return JaxTrace(capacity=0)
+
+
+def _tick_kernel(*, exact_impl="cascade", marker_mode="ring",
+                 queue_engine="gather", kernel_engine="xla",
+                 faults=False, trace=False, n=8):
+    from chandy_lamport_tpu.ops.tick import TickKernel
+    cfg = _cfg(trace_capacity=64 if trace else 0)
+    topo = _tick_topo(n)
+    delay = _delay()
+    kern = TickKernel(
+        topo, cfg, delay, marker_mode=marker_mode, exact_impl=exact_impl,
+        megatick=2, queue_engine=queue_engine, kernel_engine=kernel_engine,
+        faults=_faults() if faults else None,
+        trace=_trace() if trace else None)
+    from chandy_lamport_tpu.core.state import init_state
+    state = init_state(topo, cfg, delay.init_state())
+    return kern, state
+
+
+# ---------------------------------------------------------------------------
+# entry builders (each returns an Entry; construction is lazy so --fast
+# never pays for the arms it skips)
+
+
+def _tick_entry(impl, qe, ke, faults, trace) -> Entry:
+    kern, state = _tick_kernel(exact_impl=impl, queue_engine=qe,
+                               kernel_engine=ke, faults=faults, trace=trace)
+    key = (f"tick.{impl}.q={qe}.k={ke}.f={int(faults)}.t={int(trace)}")
+    return Entry(key=key, fn=kern._exact_tick, args=(state,),
+                 jit_fn=kern.tick, donated=(0,))
+
+
+def _sync_entry(qe, ke, faults, trace) -> Entry:
+    kern, state = _tick_kernel(exact_impl="cascade", marker_mode="split",
+                               queue_engine=qe, kernel_engine=ke,
+                               faults=faults, trace=trace)
+    key = f"sync.q={qe}.k={ke}.f={int(faults)}.t={int(trace)}"
+    return Entry(key=key, fn=kern._sync_tick, args=(state,))
+
+
+def _loop_entry(name: str) -> Entry:
+    import jax.numpy as jnp
+    kern, state = _tick_kernel()
+    if name == "run_ticks":
+        return Entry(key="tick.run_ticks", fn=kern._run_ticks,
+                     args=(state, jnp.int32(4)), jit_fn=kern.run_ticks,
+                     donated=(0,))
+    if name == "drain":
+        return Entry(key="tick.drain_and_flush", fn=kern._drain_and_flush,
+                     args=(state,), jit_fn=kern.drain_and_flush, donated=(0,))
+    if name == "inject_send":
+        return Entry(key="tick.inject_send", fn=kern._inject_send,
+                     args=(state, jnp.int32(0), jnp.int32(3)),
+                     jit_fn=kern.inject_send, donated=(0,))
+    if name == "inject_snapshot":
+        return Entry(key="tick.inject_snapshot", fn=kern._inject_snapshot,
+                     args=(state, jnp.int32(1)),
+                     jit_fn=kern.inject_snapshot, donated=(0,))
+    if name == "sync_drain":
+        kern, state = _tick_kernel(marker_mode="split")
+        return Entry(key="sync.drain_and_flush",
+                     fn=kern._sync_drain_and_flush, args=(state,))
+    raise KeyError(name)
+
+
+def _batch_runner(scheduler: str, trace=False):
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    return BatchedRunner(
+        ring_topology(8, tokens=16), _cfg(trace_capacity=64 if trace else 0),
+        _delay(), 2, scheduler=scheduler, megatick=2)
+
+
+def _storm_entry(scheduler: str) -> Entry:
+    import jax.numpy as jnp
+    from chandy_lamport_tpu.models.workloads import (
+        staggered_snapshots,
+        storm_program,
+    )
+    runner = _batch_runner(scheduler)
+    prog = storm_program(runner.topo, phases=2, amount=1,
+                         snapshot_phases=staggered_snapshots(runner.topo, 1))
+    state = runner.init_batch()
+    args = (state, tuple(jnp.asarray(x) for x in (prog.amounts, prog.snap)))
+    return Entry(key=f"batch.storm.{scheduler}", fn=runner._run_storm,
+                 args=args, jit_fn=runner._run_storm, donated=(0,),
+                 state_out=False)
+
+
+def _stream_entry() -> Entry:
+    import jax
+    import jax.numpy as jnp
+    from chandy_lamport_tpu.models.workloads import stream_jobs
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    runner = _batch_runner("sync")
+    jobs = stream_jobs(ring_topology(8, tokens=16), 4, seed=5,
+                       base_phases=2, max_phases=4)
+    pool = runner.pack_jobs(jobs)
+    stream = runner.init_stream(pool)
+    state = runner.init_batch()
+    pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
+    step = runner._stream_step(2, 8, False)
+    return Entry(key="batch.stream.step", fn=step,
+                 args=(state, stream, pool_dev), jit_fn=step,
+                 donated=(0, 1), state_out=False)
+
+
+def _graphshard_entry(comm_engine: str) -> Entry:
+    import jax
+    import numpy as np
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        staggered_snapshots,
+        storm_program,
+    )
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]), ("graph",))
+    spec = erdos_renyi(16, 2.5, seed=11, tokens=40)
+    gs = GraphShardedRunner(spec, _cfg(), mesh, fixed_delay=2,
+                            comm_engine=comm_engine)
+    prog = storm_program(gs.topo, phases=2, amount=1,
+                         snapshot_phases=staggered_snapshots(gs.topo, 1))
+    amounts_s, snap_r = gs.shard_program(np.asarray(prog.amounts),
+                                         np.asarray(prog.snap))
+    state = gs.init_state()
+    return Entry(key=f"graphshard.dispatch.comm={comm_engine}", fn=gs._run,
+                 args=(state, gs.stopo_device(), (amounts_s, snap_r)),
+                 axis_names=frozenset({"graph"}), state_out=False)
+
+
+def _pallas_entry(which: str) -> Entry:
+    import functools
+    import numpy as np
+    import jax.numpy as jnp
+    from chandy_lamport_tpu.kernels import queue, segment
+    e, c, n = 8, 8, 8
+    if which == "queue_step":
+        fn = functools.partial(queue.queue_step, capacity=c, interpret=True)
+        args = (jnp.zeros((e, c), jnp.int32), jnp.zeros((e, c), jnp.int32),
+                jnp.zeros((e,), jnp.int32), jnp.zeros((e,), jnp.int32),
+                jnp.int32(1), jnp.asarray(np.arange(e, dtype=np.int32)))
+        return Entry(key="pallas.queue_step", fn=fn, args=args,
+                     state_out=False)
+    if which == "sum_segments":
+        fn = functools.partial(segment.sum_segments, interpret=True)
+        args = (jnp.zeros((e,), jnp.int32),
+                jnp.asarray(np.arange(n, dtype=np.int32)),
+                jnp.asarray(np.arange(1, n + 1, dtype=np.int32)))
+        return Entry(key="pallas.sum_segments", fn=fn, args=args,
+                     state_out=False)
+    raise KeyError(which)
+
+
+def iter_entry_builders(mode: str = "full"):
+    """Yield (key, builder) pairs for the requested mode.
+
+    full — the whole knob matrix: exact tick {cascade,wave,fold} x
+    queue_engine {gather,mask} x kernel_engine {xla,pallas} x faults x
+    trace (fold skips faulted arms: the specification form refuses the
+    fault engine), the sync tick over the same engine arms, the loop/
+    inject entries, both storm schedulers, the stream step, both
+    graphshard comm engines, and the Pallas kernels under interpret.
+
+    fast — one arm per engine axis on the same tiny graphs: enough for
+    tier-1 to prove the audit machinery against live traces without
+    paying for the matrix (the full sweep is the slow-marked test and
+    the default CLI run).
+    """
+    if mode == "fast":
+        picks = [
+            ("tick.cascade.q=gather.k=xla.f=0.t=0",
+             lambda: _tick_entry("cascade", "gather", "xla", False, False)),
+            ("tick.wave.q=mask.k=xla.f=0.t=0",
+             lambda: _tick_entry("wave", "mask", "xla", False, False)),
+            ("tick.cascade.q=gather.k=pallas.f=0.t=0",
+             lambda: _tick_entry("cascade", "gather", "pallas", False,
+                                 False)),
+            ("sync.q=gather.k=xla.f=0.t=0",
+             lambda: _sync_entry("gather", "xla", False, False)),
+            ("pallas.queue_step", lambda: _pallas_entry("queue_step")),
+        ]
+        yield from picks
+        return
+
+    for impl in ("cascade", "wave", "fold"):
+        for qe in ("gather", "mask"):
+            for ke in ("xla", "pallas"):
+                for faults in (False, True):
+                    if impl == "fold" and faults:
+                        continue  # specification form refuses the adversary
+                    for trace in (False, True):
+                        key = (f"tick.{impl}.q={qe}.k={ke}."
+                               f"f={int(faults)}.t={int(trace)}")
+                        yield key, (lambda i=impl, q=qe, k=ke, f=faults,
+                                    t=trace: _tick_entry(i, q, k, f, t))
+    for qe in ("gather", "mask"):
+        for ke in ("xla", "pallas"):
+            for faults in (False, True):
+                for trace in (False, True):
+                    key = f"sync.q={qe}.k={ke}.f={int(faults)}.t={int(trace)}"
+                    yield key, (lambda q=qe, k=ke, f=faults, t=trace:
+                                _sync_entry(q, k, f, t))
+    for name, key in (("run_ticks", "tick.run_ticks"),
+                      ("drain", "tick.drain_and_flush"),
+                      ("inject_send", "tick.inject_send"),
+                      ("inject_snapshot", "tick.inject_snapshot"),
+                      ("sync_drain", "sync.drain_and_flush")):
+        yield key, (lambda n=name: _loop_entry(n))
+    for scheduler in ("exact", "sync"):
+        yield f"batch.storm.{scheduler}", (
+            lambda s=scheduler: _storm_entry(s))
+    yield "batch.stream.step", _stream_entry
+    for comm in ("dense", "sparse"):
+        yield f"graphshard.dispatch.comm={comm}", (
+            lambda c=comm: _graphshard_entry(c))
+    for which in ("queue_step", "sum_segments"):
+        yield f"pallas.{which}", (lambda w=which: _pallas_entry(w))
+
+
+# ---------------------------------------------------------------------------
+# trace walking
+
+
+def _sub_jaxprs(value):
+    """Yield jaxpr-like objects hiding in an eqn param value."""
+    import jax.core  # noqa: F401  (ensures types exist)
+    vals = value if isinstance(value, (tuple, list)) else (value,)
+    for v in vals:
+        if hasattr(v, "eqns"):  # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+            yield v.jaxpr
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over every eqn including sub-jaxprs (scan/cond/pjit/
+    shard_map/pallas_call bodies)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for pval in eqn.params.values():
+            for sub in _sub_jaxprs(pval):
+                yield from iter_eqns(sub)
+
+
+def _axis_names_of(eqn) -> List[str]:
+    names: List[str] = []
+    for k in _AXIS_PARAM_KEYS:
+        if k not in eqn.params:
+            continue
+        v = eqn.params[k]
+        for item in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(item, str):
+                names.append(item)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# checks
+
+
+def _check_trace(entry: Entry, closed) -> List[Violation]:
+    import jax.numpy as jnp
+    import numpy as np
+    out: List[Violation] = []
+    f64 = i64 = None
+    callbacks = set()
+    for eqn in iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if prim in HOST_CALLBACK_PRIMS:
+            callbacks.add(prim)
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is None:
+                continue
+            if dtype == jnp.float64 and f64 is None:
+                f64 = f"float64 aval in eqn {prim!r}"
+            # scalar i64 is exempt: under x64 jax itself materializes
+            # weak-typed i64 literals/consts (ref indices, normalization
+            # scalars) that lower to constants — only ARRAY-shaped 64-bit
+            # lanes cost HBM and signal a real promotion bug. Weak-typed
+            # arrays are exempt too: they are Python literals broadcast by
+            # vmap/scan batching and adopt the context dtype at every use
+            # site, so they cannot promote state.
+            if (dtype in (jnp.int64, jnp.uint64) and i64 is None
+                    and getattr(aval, "shape", ()) != ()
+                    and not getattr(aval, "weak_type", False)):
+                i64 = (f"{np.dtype(dtype).name}[{','.join(map(str, aval.shape))}] "
+                       f"aval in eqn {prim!r}")
+        if prim == "ppermute":
+            perm = eqn.params.get("perm", ())
+            srcs = [p[0] for p in perm]
+            dsts = [p[1] for p in perm]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                out.append(Violation(
+                    "ppermute-bijection", entry.key,
+                    f"ppermute perm {tuple(perm)} is not a bijection — a "
+                    f"duplicated/dropped lane deadlocks the halo ring"))
+        for name in _axis_names_of(eqn):
+            if name not in entry.axis_names:
+                out.append(Violation(
+                    "collective-axis", entry.key,
+                    f"eqn {prim!r} names axis {name!r}, which is not in "
+                    f"this entry's mesh axes {sorted(entry.axis_names)}"))
+    if f64:
+        out.append(Violation(
+            "f64-in-trace", entry.key,
+            f"{f64} — the state plan is 32-bit; a float64 anywhere means "
+            f"an unintended promotion"))
+    if i64:
+        out.append(Violation(
+            "i64-in-trace", entry.key,
+            f"{i64} — unintended 64-bit promotion (x64 is enabled in the "
+            f"canonical env precisely so these can't hide)"))
+    if callbacks:
+        out.append(Violation(
+            "host-callback", entry.key,
+            f"host callback primitives in a hot path: {sorted(callbacks)} "
+            f"— use the device flight recorder, not debug prints"))
+    if entry.state_out:
+        ok = {jnp.int32, jnp.uint32, jnp.bool_}
+        for i, aval in enumerate(closed.out_avals):
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and not any(dtype == d for d in ok):
+                out.append(Violation(
+                    "state-leaf-dtype", entry.key,
+                    f"output leaf {i} has dtype {np.dtype(dtype).name}; "
+                    f"state leaves are int32/uint32/bool by plan"))
+    consts_bytes = sum(
+        int(np.asarray(c).nbytes) for c in closed.consts
+        if hasattr(c, "nbytes") or hasattr(c, "shape"))
+    if consts_bytes > entry.const_budget:
+        out.append(Violation(
+            "const-capture", entry.key,
+            f"jaxpr captures {consts_bytes} bytes of constants "
+            f"(budget {entry.const_budget}) — big captured operands embed "
+            f"into the HLO and break remote compilation at scale"))
+    return out
+
+
+def _check_donation(entry: Entry) -> List[Violation]:
+    if entry.jit_fn is None or not entry.donated:
+        return []
+    try:
+        text = entry.jit_fn.lower(*entry.args).as_text()
+    except Exception as exc:  # pragma: no cover - lowering should not fail
+        return [Violation("donation", entry.key,
+                          f"could not lower to check donation: {exc}")]
+    if "tf.aliasing_output" not in text:
+        return [Violation(
+            "donation", entry.key,
+            f"donate_argnums={entry.donated} declared but the lowering "
+            f"shows no aliased outputs — donation silently dropped means "
+            f"2x state HBM")]
+    return []
+
+
+def _aval_sig(var) -> str:
+    import numpy as np
+    aval = getattr(var, "aval", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return "?"
+    shape = "x".join(map(str, getattr(aval, "shape", ())))
+    return f"{np.dtype(dtype).name}[{shape}]"
+
+
+def _param_sig(value) -> Optional[str]:
+    """Stable signature for value-like eqn params (ints, axis names, perm/
+    dimension tuples). Returns None for anything that could embed
+    process-specific state (functions, jaxprs — hashed structurally via
+    recursion — module paths, tracers)."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return repr(value)
+    if isinstance(value, (tuple, list)):  # NamedTuple dim-numbers included
+        parts = [_param_sig(v) for v in value]
+        if any(p is None for p in parts):
+            return None
+        return "(" + ",".join(parts) + ")"
+    try:  # np.dtype / dtype-likes
+        import numpy as np
+        return np.dtype(value).name
+    except Exception:
+        return None
+
+
+def _structure_lines(jaxpr, out: List[str]) -> None:
+    for eqn in jaxpr.eqns:
+        params = ";".join(
+            f"{k}={sig}" for k, sig in sorted(
+                (k, _param_sig(v)) for k, v in eqn.params.items())
+            if sig is not None)
+        out.append(f"{eqn.primitive.name}"
+                   f"({','.join(_aval_sig(v) for v in eqn.invars)})"
+                   f"->({','.join(_aval_sig(v) for v in eqn.outvars)})"
+                   f"{{{params}}}")
+        for pval in eqn.params.values():
+            for sub in _sub_jaxprs(pval):
+                out.append("[")
+                _structure_lines(sub, out)
+                out.append("]")
+
+
+def trace_fingerprint(closed) -> str:
+    """sha256 of a NORMALIZED structural trace: primitive names, in/out
+    aval signatures and value-like params, recursed through sub-jaxprs,
+    plus the consts signature. Deliberately NOT the pretty-printed jaxpr
+    text — that embeds var names, source annotations and module __file__
+    paths, all of which shift across jax releases and invocation styles
+    and would make the registry fail on every toolchain bump."""
+    import numpy as np
+    h = hashlib.sha256()
+    lines: List[str] = []
+    _structure_lines(closed.jaxpr, lines)
+    h.update("\n".join(lines).encode())
+    for c in closed.consts:
+        a = np.asarray(c)
+        h.update(f"{a.shape}:{a.dtype};".encode())
+    return h.hexdigest()
+
+
+REGISTRY_SCHEMA = 2
+
+
+def load_registry(path: Optional[str] = None):
+    """Returns (entries, recorded_jax_version). Reads the schema-2 layout
+    ``{"schema": 2, "jax": ..., "entries": {...}}``; a legacy flat
+    key->hash dict loads with version None."""
+    # resolved at call time so tests can repoint FINGERPRINTS_PATH
+    path = path or FINGERPRINTS_PATH
+    if not os.path.exists(path):
+        return {}, None
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "entries" in data:
+        return dict(data["entries"]), data.get("jax")
+    return dict(data), None
+
+
+def save_registry(entries: Dict[str, str],
+                  path: Optional[str] = None) -> None:
+    """Write the registry, stamping the jax version it was generated
+    under — comparisons are only binding in the same-version environment."""
+    import jax
+    path = path or FINGERPRINTS_PATH
+    payload = {
+        "schema": REGISTRY_SCHEMA,
+        "jax": jax.__version__,
+        "entries": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def audit_entry(entry: Entry, *, registry: Optional[Dict[str, str]] = None,
+                check_donation: bool = True):
+    """Trace one entry and run every check. Returns (violations, fp)."""
+    import jax
+    closed = jax.make_jaxpr(entry.fn)(*entry.args)
+    violations = _check_trace(entry, closed)
+    if check_donation:
+        violations.extend(_check_donation(entry))
+    fp = trace_fingerprint(closed)
+    if registry is not None:
+        want = registry.get(entry.key)
+        if want is None:
+            violations.append(Violation(
+                "fingerprint", entry.key,
+                "no registered lowering fingerprint — run "
+                "`python -m tools.staticcheck --fingerprints-update`"))
+        elif want != fp:
+            violations.append(Violation(
+                "fingerprint", entry.key,
+                f"lowering changed: trace fingerprint {fp[:12]}… != "
+                f"registered {want[:12]}… — intentional changes must "
+                f"regenerate fingerprints.json in the same commit"))
+    return violations, fp
+
+
+def audit(mode: str = "full", *, check_fingerprints: bool = True,
+          update_fingerprints: bool = False,
+          keys: Optional[Sequence[str]] = None):
+    """Run the jaxpr plane. Returns (violations, audited_keys, fingerprints).
+
+    ``update_fingerprints`` re-registers every traced entry instead of
+    comparing (fast mode updates only the subset it traces). Registered
+    fingerprints are only binding when the running jax matches the version
+    the registry was generated under — the structural hash is normalized,
+    but a toolchain bump can still legitimately change lowerings, so the
+    comparison is skipped (with a note) rather than failing spuriously."""
+    global _LAST_REGISTRY_NOTE
+    ensure_env()
+    _LAST_REGISTRY_NOTE = None
+    registry = None
+    if check_fingerprints and not update_fingerprints:
+        import jax
+        entries, recorded_jax = load_registry()
+        if recorded_jax is not None and recorded_jax != jax.__version__:
+            _LAST_REGISTRY_NOTE = (
+                f"fingerprint registry was generated under jax "
+                f"{recorded_jax} but this run is jax {jax.__version__}; "
+                f"comparison skipped — run --fingerprints-update to re-pin")
+        else:
+            registry = entries
+    violations: List[Violation] = []
+    audited: List[str] = []
+    fresh: Dict[str, str] = {}
+    for key, build in iter_entry_builders(mode):
+        if keys is not None and key not in keys:
+            continue
+        try:
+            entry = build()
+        except Exception as exc:
+            violations.append(Violation(
+                "entry-build", key,
+                f"could not construct the audited entry: "
+                f"{type(exc).__name__}: {exc}"))
+            continue
+        vs, fp = audit_entry(entry, registry=registry)
+        violations.extend(vs)
+        audited.append(key)
+        fresh[key] = fp
+    if update_fingerprints:
+        merged, _ = load_registry()
+        merged.update(fresh)
+        save_registry(merged)
+    return violations, audited, fresh
