@@ -271,6 +271,15 @@ def _parser() -> argparse.ArgumentParser:
                         "harvest/refill points")
     p.add_argument("--drain-chunk", type=int, default=32,
                    help="--stream: drain ticks per lane substep slice")
+    p.add_argument("--dup-rate", type=float, default=0.0, metavar="R",
+                   help="--stream: fraction of the queue that repeats a "
+                        "Zipf-drawn scenario-library job byte-for-byte "
+                        "(models/workloads.stream_jobs dup_rate)")
+    p.add_argument("--memo", choices=["off", "admit", "full"], default="off",
+                   help="--stream: ALSO drive the queue through the memo "
+                        "plane at this level and report effective jobs/s "
+                        "(served = executed + coalesced) A/B against the "
+                        "memo-off arm on the same content-keyed pool")
     p.add_argument("--trace", action="store_true",
                    help="arm the device flight recorder (utils/tracing.py) "
                         "during the measurement; the row gains trace_"
@@ -774,11 +783,17 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                            kernel_engine=args.kernel_engine, trace=trace)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
-                       tail_alpha=1.1, max_phases=max(args.phases, 8))
-    pool = runner.pack_jobs(jobs)
+                       tail_alpha=1.1, max_phases=max(args.phases, 8),
+                       dup_rate=args.dup_rate)
+    # memo A/B fairness: BOTH arms run the identical content-keyed pool
+    # (duplicate jobs share delay/fault rows), so the only difference
+    # between them is the memo plane itself
+    pool = runner.pack_jobs(jobs,
+                            content_keys=True if args.memo != "off" else None)
     log(f"stream: {jcount} jobs over {args.batch} slots, pooled phase "
         f"table {pool.do_tick.shape[0]} rows, stretch={args.stretch}, "
-        f"drain_chunk={args.drain_chunk}")
+        f"drain_chunk={args.drain_chunk}, dup_rate={args.dup_rate}, "
+        f"memo={args.memo}")
 
     def drive(admission):
         t0 = _time.perf_counter()
@@ -848,7 +863,60 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
         "straggler_wasted_steps_gang": sg["straggler_wasted_steps"],
         "stream_steps": ss["steps"],
         "gang_steps": sg["steps"],
+        "memo": args.memo,
+        "dup_rate": args.dup_rate,
+        # served == executed without the memo plane, so the off arm's
+        # effective rate IS its execution rate (the memo arm overrides)
+        "effective_jobs_per_sec": round(best["stream"], 2),
     }
+    if args.memo != "off":
+        # memo arm: same pool, same knobs, memo plane on — the headline is
+        # effective jobs SERVED per second vs the memo-off arm above
+        memo_runner = BatchedRunner(spec, cfg,
+                                    make_fast_delay(args.delay, 17),
+                                    batch=args.batch,
+                                    scheduler=args.scheduler,
+                                    exact_impl=args.exact_impl,
+                                    megatick=args.megatick,
+                                    queue_engine=args.queue_engine,
+                                    kernel_engine=args.kernel_engine,
+                                    trace=trace, memo=args.memo)
+
+        def drive_memo():
+            t0 = _time.perf_counter()
+            state, stream = memo_runner.run_stream(
+                pool, stretch=args.stretch, drain_chunk=args.drain_chunk)
+            jax.block_until_ready(state)
+            return _time.perf_counter() - t0, state, stream
+
+        dt_w, _, stream_mw = drive_memo()        # compile + audit warmup
+        served = len(memo_runner.stream_results(stream_mw))
+        log(f"memo warmup: {dt_w:.1f}s, served {served}/{jcount}")
+        if served != jcount:
+            log("ERROR: memo drive did not serve every job")
+            return 1
+        mtimes = []
+        for r in range(args.repeats):
+            dt, _, stream_m = drive_memo()
+            mtimes.append(dt)
+            log(f"memo run {r}: {dt:.3f}s -> {served / dt:.1f} "
+                f"effective jobs/s")
+        sm = memo_runner.summarize_stream(stream_m)
+        eff_memo = served / min(mtimes)
+        result.update({
+            "effective_jobs_per_sec": round(eff_memo, 2),
+            "effective_jobs_per_sec_off": result["value"],
+            # the tentpole's acceptance number: served-throughput multiple
+            # of the memo plane over the identical memo-off executable
+            "memo_speedup": round(eff_memo / best["stream"], 3)
+            if best["stream"] else 0.0,
+            "cache_hits": sm["cache_hits"],
+            "coalesced_jobs": sm["coalesced_jobs"],
+            "ff_skipped_ticks": sm["ff_skipped_ticks"],
+            "shadow_checks": sm["shadow_checks"],
+            "memo_hit_rate": sm["memo_hit_rate"],
+            "memo_steps": sm["steps"],
+        })
     if trace is not None:
         from chandy_lamport_tpu.utils.tracing import trace_counts
 

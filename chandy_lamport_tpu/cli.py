@@ -351,12 +351,14 @@ def _cmd_stream(args) -> int:
                            batch=args.batch, scheduler=args.scheduler,
                            kernel_engine=args.kernel_engine,
                            faults=faults, quarantine=faults is not None,
-                           trace=trace)
+                           trace=trace, memo=args.memo,
+                           memo_cache=args.memo_cache)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=args.seed,
                        base_phases=args.base_phases,
                        tail_alpha=args.tail_alpha,
-                       max_phases=args.max_phases)
+                       max_phases=args.max_phases,
+                       dup_rate=args.dup_rate)
     pool = runner.pack_jobs(jobs)
     state = stream = None
     if args.resume_from:
@@ -374,7 +376,8 @@ def _cmd_stream(args) -> int:
     jax.block_until_ready(state.time)
     wall = time.perf_counter() - t0
     done = int(stream.jobs_done)
-    if args.kill_after_saves is not None and done < jcount:
+    served = len(runner.stream_results(stream))
+    if args.kill_after_saves is not None and served < jcount:
         # deterministic mid-queue "preemption" for the resume tests: die
         # right after that many checkpoints landed
         print(json.dumps({"killed_after_steps": int(stream.steps),
@@ -385,8 +388,13 @@ def _cmd_stream(args) -> int:
     row.update({"graph": args.graph, "nodes": runner.topo.n,
                 "batch": args.batch, "jobs": jcount,
                 "admission": args.admission, "scheduler": args.scheduler,
+                "memo": runner.memo, "dup_rate": args.dup_rate,
                 "wall_seconds": round(wall, 3),
-                "jobs_per_sec": round(done / wall, 2) if wall > 0 else 0.0})
+                "jobs_per_sec": round(done / wall, 2) if wall > 0 else 0.0,
+                # jobs SERVED per second: executed + memo-served — the
+                # number the memo plane actually multiplies
+                "effective_jobs_per_sec":
+                    round(served / wall, 2) if wall > 0 else 0.0})
     errored = [r for r in runner.stream_results(stream) if r["error"]]
     row["jobs_errored"] = len(errored)
     if errored:
@@ -610,6 +618,23 @@ def main(argv=None) -> int:
                          "phases per job (models/workloads.stream_jobs)")
     pq.add_argument("--tail-alpha", type=float, default=1.1)
     pq.add_argument("--max-phases", type=int, default=32)
+    pq.add_argument("--dup-rate", type=float, default=0.0, metavar="R",
+                    help="fraction of the queue that repeats a Zipf-drawn "
+                         "scenario-library job byte-for-byte "
+                         "(models/workloads.stream_jobs) — the traffic "
+                         "shape the memo plane serves for free")
+    pq.add_argument("--memo", choices=["off", "admit", "full"],
+                    default="off",
+                    help="memo plane (config.ENGINE_KNOBS): 'admit' "
+                         "coalesces duplicate jobs onto one lane + serves "
+                         "persistent-cache hits; 'full' adds transition "
+                         "fast-forwarding. 'off' is bit-identical to the "
+                         "pre-memo engine; every served summary is "
+                         "bit-identical to solo execution")
+    pq.add_argument("--memo-cache", metavar="PATH",
+                    help="persistent content-addressed summary cache "
+                         "(JSON lines; utils/memocache.py) — hits across "
+                         "runs are served without burning a lane")
     pq.add_argument("--snapshots", type=int, default=8)
     pq.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     pq.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],

@@ -32,6 +32,16 @@ ENGINE_KNOBS = {
     "queue_engine": ("auto", "gather", "mask"),
     "comm_engine": ("auto", "dense", "sparse"),
     "kernel_engine": ("auto", "xla", "pallas"),
+    # memoization plane (utils/memocache.resolve_memo): "off" keeps the
+    # PR 5 stream step bit-identical (no digesting, no signature leaf
+    # ops); "admit" content-addresses jobs at pack/admit time — exact
+    # duplicates coalesce onto one representative lane and the
+    # persistent summary cache serves repeats without burning a lane;
+    # "full" adds transition fast-forwarding over the per-lane state
+    # signature. Spellings are ordered weakest-first, not "auto"-first:
+    # there is no backend-dependent resolution, only an explicit
+    # opt-in ladder.
+    "memo": ("off", "admit", "full"),
 }
 
 

@@ -221,6 +221,11 @@ CHECKPOINT_FORMAT_HISTORY: Tuple[Tuple[int, str], ...] = (
     (7, "flight-recorder leaves (tr_meta/tr_data/tr_tick/tr_count/tr_on): "
         "the device trace ring and its dropped-events accounting survive "
         "a kill mid-run"),
+    (8, "memo-plane leaf (sig: per-lane rolling state signature for "
+        "transition fast-forwarding) + StreamState memo counters "
+        "(cache_hits/coalesced_jobs/ff_skipped_ticks/shadow_checks): a "
+        "kill mid-stream resumes the fast-forward memo and hit "
+        "accounting bit-exactly"),
 )
 CHECKPOINT_FORMAT_VERSION = CHECKPOINT_FORMAT_HISTORY[-1][0]
 
@@ -410,6 +415,17 @@ class DenseState(NamedTuple):
     #                    dropped-to-wrap = max(0, count - K))
     tr_on: Any         # i32 [] runtime arm flag (1 = record; armed-idle
     #                    profiling and pre-roll muting set 0)
+    # memo-plane state (parallel/batch memo="full"; checkpoint format v8
+    # leaf). A rolling uint32 fingerprint over the SEMANTIC per-lane
+    # leaves (tokens, ring content/occupancy, snapshot planes, delay and
+    # fault stream state, cursor scalars — everything except time,
+    # admit_tick and the trace ring), recomputed inside the jitted
+    # stream step. The host fast-forward memo watches it: when a
+    # draining lane's signature recurs at the same program cursor, the
+    # lane is provably cycling and whole multiples of the observed
+    # period are credited to ``time`` without re-ticking. 0 whenever
+    # memo != "full" (the leaf is carried untouched — zero ops).
+    sig: Any           # u32 [] rolling per-lane state signature
     error: Any         # i32 [] sticky bitmask
 
 
@@ -465,6 +481,7 @@ def init_state(topo: DenseTopology, cfg: SimConfig, delay_state: Any,
         tr_tick=np.zeros(cfg.trace_capacity, i32),
         tr_count=np.int32(0),
         tr_on=np.int32(1),
+        sig=np.uint32(0),
         error=np.int32(0),
     )
 

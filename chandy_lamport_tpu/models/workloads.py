@@ -86,7 +86,8 @@ def scale_free(n: int, attach: int, seed: int,
 def stream_jobs(spec: TopologySpec, count: int, seed: int,
                 base_phases: int = 4, tail_alpha: float = 1.1,
                 max_phases: int = 64, amount: int = 1,
-                snapshots_per_job: int = 1) -> List[List[Event]]:
+                snapshots_per_job: int = 1,
+                dup_rate: float = 0.0) -> List[List[Event]]:
     """A heavy-tailed job mix for the streaming engine
     (parallel/batch.run_stream): ``count`` event-list jobs whose phase
     counts follow a Pareto(``tail_alpha``) tail over ``base_phases``
@@ -98,9 +99,34 @@ def stream_jobs(spec: TopologySpec, count: int, seed: int,
     underflows for any sane phase cap); each job initiates
     ``snapshots_per_job`` snapshots, the first early (phase 1) and the
     rest spread, from a per-job rotating initiator. Deterministic in
-    ``seed``."""
+    ``seed``.
+
+    ``dup_rate``: fraction of the jobs that are byte-identical repeats
+    drawn from the remaining unique "scenario library" — production
+    streams replay a small library of scenarios far more often than they
+    invent new ones, and repeats are exactly what the memo plane
+    (``memo`` runner knob) serves for free. A library of
+    ``max(1, round(count * (1 - dup_rate)))`` unique jobs is generated
+    first; each repeat slot then draws a library index Zipf-style
+    (weight 1/(k+1), so early scenarios dominate — the hot-set shape)
+    and the draws are shuffled in among the originals. dup_rate 0 (the
+    default) reproduces the historical all-unique mix bit-for-bit."""
     if count < 1:
         raise ValueError("count must be >= 1")
+    if not 0.0 <= dup_rate < 1.0:
+        raise ValueError("dup_rate must be in [0, 1)")
+    if dup_rate:
+        nuniq = max(1, round(count * (1.0 - dup_rate)))
+        library = stream_jobs(spec, nuniq, seed, base_phases=base_phases,
+                              tail_alpha=tail_alpha, max_phases=max_phases,
+                              amount=amount,
+                              snapshots_per_job=snapshots_per_job)
+        rng = random.Random(seed + 0x5EED)
+        weights = [1.0 / (k + 1) for k in range(nuniq)]
+        picks = rng.choices(range(nuniq), weights=weights, k=count - nuniq)
+        mix = list(library) + [library[k] for k in picks]
+        rng.shuffle(mix)
+        return mix
     rng = random.Random(seed)
     links = list(spec.links)
     node_ids = [nid for nid, _ in spec.nodes]

@@ -46,9 +46,17 @@ from chandy_lamport_tpu.ops.tick import (
     harvest_lane_summaries,
     reset_lanes,
 )
+from chandy_lamport_tpu.utils.memocache import (
+    MemoCacheError,
+    SummaryCache,
+    job_digest,
+    resolve_memo,
+)
 from chandy_lamport_tpu.utils.tracing import (
     EV_LANE_ADMIT,
+    EV_LANE_COALESCE,
     EV_LANE_HARVEST,
+    EV_MEMO_HIT,
     JaxTrace,
     trace_append_lanes,
     trace_counts,
@@ -63,6 +71,100 @@ from chandy_lamport_tpu.utils.layouts import (
 )
 
 OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
+
+# With memo != "off", every MEMO_SHADOW_EVERY-th job that would be served
+# without execution (persistent-cache hit or coalesced follower) ALSO runs
+# solo on a lane, and its harvested summary is compared bit-for-bit
+# against the served one — a standing audit that memoized answers stay
+# exact (a mismatch raises MemoCacheError naming the digest).
+# run_stream(shadow_every=...) overrides it (0 disables; tests tighten it
+# to 1 for full coverage).
+MEMO_SHADOW_EVERY = 16
+
+# DenseState leaves EXCLUDED from the per-lane state signature: ``time``
+# deliberately (fast-forwarding asks "is this state invariant under the
+# tick MODULO time?"), observability-only leaves (the trace ring planes +
+# arm flag), the signature itself, and ``admit_tick`` (a stream-step
+# stamp, not simulation state). Everything else — tokens, both queue
+# engines' message planes, snapshot/supervisor books, delay-sampler
+# state, fault books, job/cursor — is hashed, so a signature recurrence
+# means the lane's semantic state truly recurred.
+_SIG_SKIP_LEAVES = frozenset((
+    "time", "admit_tick", "tr_meta", "tr_data", "tr_tick", "tr_count",
+    "tr_on", "sig"))
+
+
+def _sig_words(leaf):
+    """One leaf flattened to u32 words for the signature hash. 8-byte
+    dtypes split into lo/hi halves and floats go through a bitcast, so no
+    bit of any leaf is dropped (a sum-of-casts that truncated would alias
+    states that differ only in high bits)."""
+    x = jnp.reshape(jnp.asarray(leaf), (-1,))
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        nbits = x.dtype.itemsize * 8
+        x = lax.bitcast_convert_type(
+            x, {16: jnp.uint16, 32: jnp.uint32, 64: jnp.uint64}[nbits])
+    if x.dtype.itemsize == 8:
+        u = x.astype(jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        return jnp.concatenate([lo, hi])
+    return x.astype(jnp.uint32)
+
+
+def _lane_signature(s) -> Any:
+    """u32 rolling fingerprint of ONE lane's semantic state (vmapped over
+    the batch by the caller). Per leaf: a position-weighted u32 sum
+    (multiplicative weights keep permuted contents from colliding), then
+    an FNV-style combine across leaves with a per-leaf salt so equal
+    leaf hashes at different positions don't cancel. Pure elementwise +
+    reductions — a few fused ops per step, cheap next to a tick. Equal
+    states always hash equal; the (vanishingly unlikely) 32-bit collision
+    is the accepted residual risk that the shadow re-execution audit
+    (MEMO_SHADOW_EVERY) exists to catch."""
+    acc = jnp.uint32(0x9E3779B9)
+    idx = 0
+    for name, val in s._asdict().items():
+        if name in _SIG_SKIP_LEAVES:
+            continue
+        for leaf in jax.tree_util.tree_leaves(val):
+            w = _sig_words(leaf)
+            h = jnp.sum(
+                w * (jnp.arange(w.size, dtype=jnp.uint32)
+                     * jnp.uint32(2654435761) + jnp.uint32(0x85EBCA6B)),
+                dtype=jnp.uint32)
+            acc = (acc * jnp.uint32(1000003)) ^ (
+                h + jnp.uint32((idx * 0x9E3779B9) & 0xFFFFFFFF))
+            idx += 1
+    return acc
+
+
+def _ring_rows(stream) -> List[dict]:
+    """Decode a StreamState's harvested results ring into per-job dict
+    rows (host side; only the newest ``capacity`` rows survive wrap)."""
+    from chandy_lamport_tpu.core.state import decode_error_bits
+
+    host = jax.device_get(stream)
+    rcap = int(np.shape(host.res_job)[0])
+    rows = []
+    for i in range(min(int(host.res_count), rcap)):
+        err = int(host.res_error[i])
+        rows.append({
+            "job": int(host.res_job[i]),
+            "time": int(host.res_time[i]),
+            "error": err,
+            "errors_decoded": decode_error_bits(err),
+            "snapshots_started": int(host.res_snap_started[i]),
+            "snapshots_completed": int(host.res_snap_completed[i]),
+            "snapshots_failed": int(host.res_snap_failed[i]),
+            "fault_skew": int(host.res_fault_skew[i]),
+            "fault_events": int(host.res_fault_events[i]),
+            "admit_step": int(host.res_admit_step[i]),
+            "tokens": np.asarray(host.res_tokens[i]).astype(int).tolist(),
+        })
+    return rows
 
 
 def _formats_match(tree, formats) -> bool:
@@ -159,7 +261,13 @@ class JobPool(NamedTuple):
     (models/faults + ops/delay_jax ``init_batch_state(J)``): admission
     copies job j's row into the lane, so job j replays the same fault and
     delay streams whichever lane runs it, whenever it was admitted — the
-    stream-vs-static parity oracle."""
+    stream-vs-static parity oracle.
+
+    ``digest[j]`` is the job's content address (utils/memocache.job_digest
+    over topology + script + stream identities + resolved knobs + config)
+    as raw sha256 bytes — all-zero rows when the runner's memo plane is
+    off (pack_jobs computes digests only under ``content_keys``, where
+    duplicate scripts share stream identities and therefore digests)."""
 
     kind: Any        # i32 [P, K]  pooled phase ops (batch.compile_events)
     arg0: Any        # i32 [P, K]
@@ -169,6 +277,7 @@ class JobPool(NamedTuple):
     job_end: Any     # i32 [J]     one past job j's last row
     job_limit: Any   # i32 [J]     drain budget: total script ticks + max_ticks
     fault_key: Any   # u32 [J]     per-job adversary key (0 = disarmed)
+    digest: Any      # u8 [J, 32]  sha256 content address (0s when memo off)
     delay_state: Any  # pytree, leaves [J, ...]: per-job delay stream rows
 
     @property
@@ -184,12 +293,24 @@ class StreamState(NamedTuple):
     ``(state, stream)`` pytree through utils/checkpoint.save_state), so a
     resumed run continues mid-queue bit-exactly."""
 
-    next_job: Any          # i32 []  jobs admitted so far (= next pool index)
+    next_job: Any          # i32 []  jobs admitted so far (with the memo
+    #                        plane on this indexes the EXEC ORDER, not the
+    #                        pool: admission maps it through run_stream's
+    #                        deduplicated order array)
     jobs_done: Any         # i32 []  jobs harvested into the ring
     steps: Any             # i32 []  stream steps executed
     refills: Any           # i32 []  admissions into a RECYCLED slot
     lane_steps_live: Any   # i32 []  lane-substeps that advanced a live job
     lane_steps_total: Any  # i32 []  lane-substeps charged (occupancy denom)
+    # memo-plane accounting (checkpoint format v8 counters): cache_hits/
+    # coalesced_jobs/shadow_checks are host-stamped once the run retires
+    # (they are properties of the admission plan); ff_skipped_ticks
+    # accumulates on-device in _ff_apply, so a kill mid-stream resumes
+    # the skipped-tick books bit-exactly
+    cache_hits: Any        # i32 []  jobs served from the persistent cache
+    coalesced_jobs: Any    # i32 []  duplicate jobs served by a rep lane
+    ff_skipped_ticks: Any  # i32 []  ticks credited by fast-forward
+    shadow_checks: Any     # i32 []  served summaries re-proven by shadow
     res_count: Any         # i32 []  results written (ring wraps past R)
     res_job: Any            # i32 [R]    job id (-1 = empty slot)
     res_time: Any           # i32 [R]    final lane clock
@@ -218,7 +339,8 @@ class BatchedRunner:
                  auto_layouts: bool = False, megatick: int = 1,
                  queue_engine: str = "auto",
                  kernel_engine: Optional[str] = None, faults=None,
-                 quarantine: bool = False, trace=None):
+                 quarantine: bool = False, trace=None,
+                 memo: str = "off", memo_cache: Optional[str] = None):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -294,9 +416,33 @@ class BatchedRunner:
         ``trace_capacity`` at 0, it is bumped to the trace's capacity here
         so the ring planes exist. None (default) compiles every trace op
         away — the kernels are bit-identical to a build without the
-        feature (the faults=None contract)."""
+        feature (the faults=None contract).
+
+        memo: the memoization plane over run_stream (config.ENGINE_KNOBS;
+        utils/memocache docstring). "off" (default) keeps the stream step
+        bit-identical to the pre-memo engine — no digesting, no
+        signature ops, no admission indirection. "admit" turns on
+        content-addressed admission: pack_jobs derives per-job stream
+        identities by script CONTENT (duplicates share fault/delay rows,
+        so their digests — and summaries — coincide), run_stream
+        coalesces in-pool duplicates onto one representative lane and
+        serves digests resident in the persistent cache without burning
+        a lane at all. "full" additionally maintains the per-lane state
+        signature leaf inside the jitted step and fast-forwards lanes
+        whose signature recurs (run_stream docstring). Every served
+        summary is audited by periodic shadow re-execution
+        (MEMO_SHADOW_EVERY). ``memo_cache``: path of the persistent
+        JSON-lines summary cache (memocache.SummaryCache; None keeps the
+        cache in-memory per run, so only coalescing and fast-forwarding
+        apply across one call)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
+        self.memo = resolve_memo(memo)
+        self.memo_cache_path = memo_cache
+        # per-run rows served without execution (job -> result row);
+        # stream_results merges them with the harvested ring
+        self._memo_rows: dict = {}
+        self._topo_spec = topology
         self.delay = delay
         self.batch = batch
         # flush length must cover the sampler's actual max delay
@@ -748,12 +894,24 @@ class BatchedRunner:
     # each job in a static batch (tests/test_stream.py holds this across
     # schedulers, faults and quarantine).
 
-    def pack_jobs(self, jobs, fault_armed=None) -> JobPool:
+    def pack_jobs(self, jobs, fault_armed=None,
+                  content_keys: Optional[bool] = None) -> JobPool:
         """Compile + pack J jobs (event lists or pre-compiled ScriptOps)
         into one pooled phase table. ``fault_armed``: optional [J] bools —
         when the runner carries a fault adversary, arms exactly those jobs
         (per-JOB keys from faults.init_batch_state(J), zeroed where
-        disarmed); default arms all. Without an adversary all keys are 0."""
+        disarmed); default arms all. Without an adversary all keys are 0.
+
+        ``content_keys`` (default: on iff the runner's memo plane is on):
+        derive the per-job fault/delay stream identities by script CONTENT
+        rank instead of pool index — jobs with byte-identical compiled
+        scripts share the same ``init_batch_state`` row, so exact
+        duplicates run the identical computation on identical operands and
+        their content digests (``JobPool.digest``) coincide. The memo
+        plane requires this (index-derived rows would give every
+        duplicate a distinct stream and nothing would ever coalesce);
+        the default off-path keeps the pre-memo index contract
+        bit-exactly. Digests are computed only under ``content_keys``."""
         scripts = [j if isinstance(j, ScriptOps)
                    else compile_events(self.topo, j) for j in jobs]
         if not scripts:
@@ -784,15 +942,90 @@ class BatchedRunner:
             limit[j] = int(np.sum(np.asarray(s.do_tick))) + \
                 self.config.max_ticks
             row += t
+        if content_keys is None:
+            content_keys = self.memo != "off"
+        if content_keys:
+            # content rank: first-appearance index of each distinct
+            # compiled script (bytes of the padded op tensors — two jobs
+            # get the same rank iff their pooled rows are identical)
+            u_of: dict = {}
+            u_index = np.zeros(jcount, np.int64)
+            for j, s in enumerate(scripts):
+                sig = (s.kind.shape,
+                       np.asarray(s.kind).tobytes(),
+                       np.asarray(s.arg0).tobytes(),
+                       np.asarray(s.arg1).tobytes(),
+                       np.asarray(s.do_tick).tobytes())
+                u_index[j] = u_of.setdefault(sig, len(u_of))
+            nuniq = len(u_of)
+        else:
+            u_index = np.arange(jcount)
+            nuniq = jcount
         if self.faults is not None:
-            keys = np.asarray(self.faults.init_batch_state(jcount))
+            keys = np.asarray(self.faults.init_batch_state(nuniq))[u_index]
             if fault_armed is not None:
                 keys = np.where(np.asarray(fault_armed, bool), keys,
                                 keys.dtype.type(0))
         else:
             keys = np.zeros(jcount, np.uint32)
+        if content_keys:
+            delay_rows = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[u_index],
+                self.delay.init_batch_state(nuniq))
+            digests = self._job_digests(scripts, u_index, keys, delay_rows)
+        else:
+            # the pre-memo path, untouched: index-derived rows handed to
+            # the pool as built (stream-vs-static parity depends on it)
+            delay_rows = self.delay.init_batch_state(jcount)
+            digests = np.zeros((jcount, 32), np.uint8)
         return JobPool(kind, arg0, arg1, do_tick, start, end, limit, keys,
-                       self.delay.init_batch_state(jcount))
+                       digests, delay_rows)
+
+    def _job_digests(self, scripts, u_index, keys, delay_rows) -> np.ndarray:
+        """[J, 32] sha256 content addresses (utils/memocache.job_digest):
+        everything that determines job j's summary bit-for-bit — topology,
+        its compiled script, its fault/delay stream rows, and the runner's
+        resolved execution identity (scheduler, engines, semantic config).
+        Duplicate (script, fault key) pairs hash once."""
+        import dataclasses
+
+        cfg_fields = dataclasses.asdict(self.config)
+        # trace_capacity changes only observability (the flight-recorder
+        # ring), never a summary — the one excluded field
+        cfg_fields.pop("trace_capacity")
+        knobs = {
+            "queue_engine": self.queue_engine,
+            "kernel_engine": self.kernel_engine,
+            "exact_impl": self.kernel.exact_impl,
+            "megatick": self.megatick,
+            "check_every": self.check_every,
+            "quarantine": self.quarantine,
+            "delay_kind": type(self.delay).__name__,
+            "faults": (None if self.faults is None
+                       else sorted(vars(self.faults).items())),
+        }
+        leaves, treedef = jax.tree_util.tree_flatten(
+            jax.device_get(delay_rows))
+        leaves = [np.asarray(x) for x in leaves]
+        out = np.zeros((len(scripts), 32), np.uint8)
+        seen: dict = {}
+        for j, s in enumerate(scripts):
+            # same content rank + same armed key -> same digest, hash once
+            memo_key = (int(u_index[j]), int(keys[j]))
+            hx = seen.get(memo_key)
+            if hx is None:
+                hx = job_digest(
+                    topo_spec=self._topo_spec,
+                    script=(np.asarray(s.kind), np.asarray(s.arg0),
+                            np.asarray(s.arg1), np.asarray(s.do_tick)),
+                    fault_key=int(keys[j]),
+                    delay_row={"treedef": str(treedef),
+                               "leaves": [lv[j] for lv in leaves]},
+                    scheduler=self.scheduler, knobs=knobs,
+                    config_fields=cfg_fields)
+                seen[memo_key] = hx
+            out[j] = np.frombuffer(bytes.fromhex(hx), np.uint8)
+        return out
 
     def init_stream(self, pool: JobPool,
                     results_capacity: Optional[int] = None) -> StreamState:
@@ -809,7 +1042,9 @@ class BatchedRunner:
 
         return StreamState(
             next_job=i(0), jobs_done=i(0), steps=i(0), refills=i(0),
-            lane_steps_live=i(0), lane_steps_total=i(0), res_count=i(0),
+            lane_steps_live=i(0), lane_steps_total=i(0),
+            cache_hits=i(0), coalesced_jobs=i(0), ff_skipped_ticks=i(0),
+            shadow_checks=i(0), res_count=i(0),
             res_job=np.full(r, -1, np.int32), res_time=z(r), res_error=z(r),
             res_snap_started=z(r), res_snap_completed=z(r),
             res_snap_failed=z(r), res_fault_skew=z(r), res_fault_events=z(r),
@@ -818,7 +1053,7 @@ class BatchedRunner:
     def _stream_step(self, stretch: int, drain_chunk: int, gang: bool):
         if not hasattr(self, "_stream_jits"):
             self._stream_jits = {}
-        key = (int(stretch), int(drain_chunk), bool(gang))
+        key = (int(stretch), int(drain_chunk), bool(gang), self.memo)
         fn = self._stream_jits.get(key)
         if fn is None:
             fn = jax.jit(self._build_stream_step(*key),
@@ -826,7 +1061,8 @@ class BatchedRunner:
             self._stream_jits[key] = fn
         return fn
 
-    def _build_stream_step(self, stretch: int, drain_chunk: int, gang: bool):
+    def _build_stream_step(self, stretch: int, drain_chunk: int, gang: bool,
+                           memo: str = "off"):
         """One jitted streaming step: harvest retired lanes -> admit queued
         jobs into the freed slots -> advance every lane through the
         per-lane stage machine. The stage machine replays run()'s exact
@@ -910,9 +1146,15 @@ class BatchedRunner:
                                   lambda _, t: tick(t), s)
                 return s._replace(prog_cursor=s.prog_cursor + 1)
 
-            return lax.cond(stage_of(s) == 3, flush, lambda u: u, s)
+            s = lax.cond(stage_of(s) == 3, flush, lambda u: u, s)
+            if memo == "full":
+                # memo plane: refresh the rolling state signature once per
+                # pass; the host fast-forward keys on (job, cursor, sig)
+                # recurrence across steps (run_stream)
+                s = s._replace(sig=_lane_signature(s))
+            return s
 
-        def step(state, stream, pool):
+        def step(state, stream, pool, order=None, followers=None):
             jcount = pool.job_start.shape[0]
             jmax = jcount - 1
             rcap = stream.res_job.shape[0]
@@ -954,14 +1196,27 @@ class BatchedRunner:
                 jobs_done=stream.jobs_done + nfin)
             # -- admit: reset freed slots, copy in per-job identities ------
             idle_lane = fin | ~has_job
-            avail = jcount - stream.next_job
             arank = jnp.cumsum(idle_lane.astype(jnp.int32)) - 1
             # gang admission = the static-batching baseline on the SAME
             # executable: refill only when every lane is idle, so whole
             # cohorts run and retire together (bench's fair comparison)
             gate = jnp.all(idle_lane) if gang else jnp.bool_(True)
-            admit = idle_lane & (arank < avail) & gate
-            new_jid = stream.next_job + arank
+            if memo == "off":
+                avail = jcount - stream.next_job
+                admit = idle_lane & (arank < avail) & gate
+                new_jid = stream.next_job + arank
+            else:
+                # memoized admission: next_job walks the deduplicated EXEC
+                # ORDER (one representative lane per distinct digest, plus
+                # the shadow re-executions), not the raw pool — the pool
+                # row actually admitted is order[pos]. followers[pos]
+                # counts the coalesced duplicates this representative also
+                # serves; run_stream fans its summary out at finalize.
+                uexec = order.shape[0]
+                avail = uexec - stream.next_job
+                admit = idle_lane & (arank < avail) & gate
+                epos = jnp.clip(stream.next_job + arank, 0, uexec - 1)
+                new_jid = jnp.where(admit, order[epos], -1)
             new_jidc = jnp.clip(new_jid, 0, jmax)
             reset = fin | admit
             if self._trace_on:
@@ -994,6 +1249,10 @@ class BatchedRunner:
             if self._trace_on:
                 state = trace_append_lanes(state, admit, EV_LANE_ADMIT,
                                            new_jid)
+            if self._trace_on and memo != "off":
+                fcnt = followers[epos]
+                state = trace_append_lanes(state, admit & (fcnt > 0),
+                                           EV_LANE_COALESCE, fcnt)
             stream = stream._replace(
                 next_job=stream.next_job + jnp.sum(admit, dtype=jnp.int32),
                 refills=stream.refills + jnp.sum(admit & fin,
@@ -1016,6 +1275,197 @@ class BatchedRunner:
 
         return step
 
+    def _ff_step(self):
+        """The jitted fast-forward credit: apply per-lane tick skips the
+        host computed from a signature recurrence (_ff_host). The device
+        re-checks eligibility as defense in depth: no armed fault
+        adversary (its stream is time-indexed, models/faults._word), no
+        message in flight in either queue engine (a message at a future
+        rtime would be jumped over), no armed supervisor deadline (it
+        compares against the clock), no error. For an eligible lane every
+        remaining drain tick is provably pure ``time += 1``, so the jump
+        lands on exactly the state a tick-by-tick run would reach."""
+        fn = getattr(self, "_ff_jit", None)
+        if fn is None:
+            cfg = self.config
+
+            def apply(state, stream, skips):
+                eligible = ((state.fault_key == jnp.uint32(0))
+                            & (state.error == 0)
+                            & ~jnp.any(state.q_len > 0, axis=-1)
+                            & ~jnp.any(state.m_pending, axis=(-2, -1)))
+                if cfg.snapshot_timeout > 0:
+                    eligible = eligible & ~jnp.any(
+                        state.snap_deadline > 0, axis=-1)
+                skip = jnp.where(eligible, skips, 0).astype(jnp.int32)
+                state = state._replace(time=state.time + skip)
+                if self._trace_on:
+                    state = trace_append_lanes(state, skip > 0,
+                                               EV_MEMO_HIT, skip)
+                stream = stream._replace(
+                    ff_skipped_ticks=stream.ff_skipped_ticks
+                    + jnp.sum(skip, dtype=jnp.int32))
+                return state, stream
+
+            fn = jax.jit(apply, donate_argnums=(0, 1))
+            self._ff_jit = fn
+        return fn
+
+    def _ff_host(self, state, stream, pool, seen):
+        """Host half of transition fast-forwarding (memo='full'): watch
+        each lane's (job, cursor, signature) across steps. A recurrence
+        at the SAME drain cursor with time strictly advanced means the
+        lane's semantic state is invariant under the tick — the
+        generalization of TickKernel._run_ticks' quiescence fast-forward
+        from "ring empty" to "state fixed point" — so the remaining wait
+        to its tick limit is credited in one jump: whole multiples of the
+        observed period, stopping short of the limit so the
+        ERR_TICK_LIMIT edge replays tick-exactly. ``seen`` maps lane ->
+        (key, time at last sighting) and persists across steps; any
+        cursor/job change resets the watch."""
+        jid = np.asarray(state.job_id)
+        cur = np.asarray(state.prog_cursor)
+        sig = np.asarray(state.sig)
+        tnow = np.asarray(state.time)
+        jend = np.asarray(pool.job_end)
+        jlim = np.asarray(pool.job_limit)
+        skips = np.zeros(self.batch, np.int32)
+        fire = False
+        for lane in range(self.batch):
+            j = int(jid[lane])
+            # only the drain stage can cycle (script rows and the flush
+            # are fixed-length), so anything else resets the watch
+            if j < 0 or int(cur[lane]) != int(jend[j]):
+                seen.pop(lane, None)
+                continue
+            key = (j, int(cur[lane]), int(sig[lane]))
+            t = int(tnow[lane])
+            prev = seen.get(lane)
+            if prev is not None and prev[0] == key and t > prev[1]:
+                dt = t - prev[1]
+                k = (int(jlim[j]) - t - 1) // dt
+                if k > 0:
+                    skips[lane] = k * dt
+                    fire = True
+            seen[lane] = (key, t)
+        if fire:
+            state, stream = self._ff_step()(state, stream,
+                                            jnp.asarray(skips))
+        return state, stream
+
+    def _memo_plan(self, pool: JobPool, shadow_every: Optional[int]) -> dict:
+        """Host-side admission plan for a memoized run: classify every
+        pool job by digest into leader (executes on a lane), coalesced
+        follower (served from its leader's harvest) or persistent-cache
+        hit (served without any lane at all), and pick the shadow
+        re-executions (every ``shadow_every``-th served job also runs
+        solo for the bit-exactness audit). Deterministic for a given
+        (pool, cache file) — and the cache file only changes at the END
+        of a run (SummaryCache.flush), so a killed run re-plans
+        identically on resume and the checkpointed stream carry stays
+        consistent with the exec order."""
+        digests = [bytes(bytearray(np.asarray(pool.digest[j], np.uint8)
+                                   .tolist())).hex()
+                   for j in range(pool.num_jobs)]
+        if pool.num_jobs and all(d == "0" * 64 for d in digests):
+            raise ValueError(
+                "memo != 'off' needs a content-addressed pool — pack_jobs "
+                "on a memo-enabled runner (or content_keys=True) stamps "
+                "the job digests")
+        cache = SummaryCache(self.memo_cache_path)
+        se = MEMO_SHADOW_EVERY if shadow_every is None else int(shadow_every)
+        leader: dict = {}       # digest -> ("exec", job) | ("cache", summary)
+        exec_jobs: List[int] = []   # pool indices in admission order
+        fcounts: dict = {}          # exec job -> coalesced follower count
+        served: List[tuple] = []    # (job, "cache"|"coalesce", digest, ref)
+        shadows: set = set()
+        nserved = 0
+
+        def maybe_shadow(j):
+            nonlocal nserved
+            nserved += 1
+            if se and (nserved - 1) % se == 0:
+                shadows.add(j)
+                exec_jobs.append(j)
+                fcounts.setdefault(j, 0)
+
+        for j, dg in enumerate(digests):
+            led = leader.get(dg)
+            if led is None:
+                hit = cache.get(dg)
+                if hit is not None:
+                    leader[dg] = ("cache", dict(hit))
+                    served.append((j, "cache", dg, dict(hit)))
+                    maybe_shadow(j)
+                else:
+                    leader[dg] = ("exec", j)
+                    exec_jobs.append(j)
+                    fcounts[j] = 0
+            else:
+                kind, ref = led
+                if kind == "exec":
+                    fcounts[ref] += 1
+                    served.append((j, "coalesce", dg, ref))
+                else:
+                    served.append((j, "cache", dg, dict(ref)))
+                maybe_shadow(j)
+        return {"digests": digests, "cache": cache, "exec": exec_jobs,
+                "follower_counts": [fcounts[e] for e in exec_jobs],
+                "served": served, "shadows": shadows}
+
+    def _memo_finalize(self, state, stream, plan: dict):
+        """After the device loop drains the exec order: write executed
+        leaders' summaries back to the cache (atomic flush), materialize
+        every served row (follower / cache hit) with provenance stamps,
+        run the shadow audit, and set the host-side memo counters."""
+        ring = {r["job"]: r for r in _ring_rows(stream)}
+        cache = plan["cache"]
+        digests = plan["digests"]
+
+        def summary_of(row):
+            return {k: v for k, v in row.items()
+                    if k not in ("job", "admit_step")}
+
+        for e in plan["exec"]:
+            r = ring.get(e)
+            if r is not None:
+                cache.put(digests[e], summary_of(r))
+        nshadow = 0
+        for j, src, dg, ref in plan["served"]:
+            if src == "cache":
+                summ = dict(ref)
+            else:
+                r = ring.get(ref)
+                if r is None:
+                    # leader evicted from an undersized results ring — the
+                    # follower cannot be served (summarize_stream reports
+                    # the eviction; the default capacity never evicts)
+                    continue
+                summ = summary_of(r)
+            if j in plan["shadows"]:
+                solo = ring.get(j)
+                if solo is not None:
+                    nshadow += 1
+                    if summary_of(solo) != summ:
+                        raise MemoCacheError(
+                            f"shadow re-execution of job {j} (digest {dg}) "
+                            f"disagrees with its served summary — the "
+                            f"memoized result is not bit-exact; refusing "
+                            f"to serve it")
+            row = dict(summ)
+            row["job"] = j
+            row["admit_step"] = -1        # never held a lane
+            row["digest"] = dg            # provenance: producer's address
+            row["served_from"] = src
+            self._memo_rows[j] = row
+        cache.flush()
+        ncache = sum(1 for it in plan["served"] if it[1] == "cache")
+        ncoal = sum(1 for it in plan["served"] if it[1] == "coalesce")
+        stream = stream._replace(cache_hits=np.int32(ncache),
+                                 coalesced_jobs=np.int32(ncoal),
+                                 shadow_checks=np.int32(nshadow))
+        return state, stream
+
     def run_stream(self, jobs, *, stretch: int = 4, drain_chunk: int = 32,
                    admission: str = "stream",
                    results_capacity: Optional[int] = None,
@@ -1023,7 +1473,8 @@ class BatchedRunner:
                    stream: Optional[StreamState] = None,
                    max_steps: int = 1_000_000, checkpoint: Optional[str] = None,
                    checkpoint_every: int = 0,
-                   kill_after_saves: Optional[int] = None):
+                   kill_after_saves: Optional[int] = None,
+                   shadow_every: Optional[int] = None):
         """Drive a queue of jobs through the B lane slots; returns the final
         ``(state, stream)``. ``jobs``: a JobPool (pack_jobs) or a list of
         event lists / ScriptOps. ``admission``: 'stream' (default) refills
@@ -1040,12 +1491,23 @@ class BatchedRunner:
 
         Checkpointing: with ``checkpoint`` + ``checkpoint_every`` k, every
         k-th step atomically saves the combined ``(state, stream)`` pytree
-        (utils/checkpoint.save_state — format v7). Resume by loading with
+        (utils/checkpoint.save_state — format v8). Resume by loading with
         ``like=(runner.init_batch(), runner.init_stream(pool))`` and
         passing ``state=``/``stream=`` back in; the continuation is
         bit-exact because admission order, per-job streams and the results
-        ring all live in the saved carry. ``kill_after_saves``: stop right
-        after that many saves (preemption drills; tests)."""
+        ring all live in the saved carry (and, under memo, the admission
+        plan is a pure function of (pool, cache file), which only changes
+        at the END of a completed run). ``kill_after_saves``: stop right
+        after that many saves (preemption drills; tests).
+
+        Memoization (``memo`` runner knob): with memo != 'off' only one
+        representative per distinct job digest is admitted; duplicate
+        followers and persistent-cache hits are served their
+        representative's summary at the end (stream_results rows carry
+        ``digest`` + ``served_from`` provenance). With memo == 'full',
+        lanes whose state signature recurs mid-drain are fast-forwarded
+        to their tick limit (_ff_host). ``shadow_every`` overrides
+        MEMO_SHADOW_EVERY for the bit-exactness audit (0 disables)."""
         from chandy_lamport_tpu.utils.checkpoint import save_state
 
         if admission not in ("stream", "gang"):
@@ -1053,62 +1515,75 @@ class BatchedRunner:
         if stretch < 1 or drain_chunk < 1:
             raise ValueError("stretch and drain_chunk must be >= 1")
         pool = jobs if isinstance(jobs, JobPool) else self.pack_jobs(jobs)
+        jcount = pool.num_jobs
+        memo = self.memo
+        self._memo_rows = {}
+        if memo == "off":
+            plan = order_dev = followers_dev = None
+            target = jcount
+        else:
+            plan = self._memo_plan(pool, shadow_every)
+            target = len(plan["exec"])
+            order_dev = jnp.asarray(np.asarray(plan["exec"], np.int32))
+            followers_dev = jnp.asarray(
+                np.asarray(plan["follower_counts"], np.int32))
         if state is None:
             state = self.init_batch()
         if stream is None:
             stream = self.init_stream(pool, results_capacity)
         step = self._stream_step(stretch, drain_chunk, admission == "gang")
         pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
-        jcount = pool.num_jobs
+        # fast-forward needs signature recurrence to imply a frozen lane;
+        # periodic re-initiation is clock-driven, so it is fenced off here
+        # (the armed-deadline fence in _ff_step covers snapshot_timeout)
+        ff = memo == "full" and self.config.snapshot_every == 0
+        ff_seen: dict = {}
         saves = 0
-        for _ in range(int(max_steps)):
-            state, stream = step(state, stream, pool_dev)
-            done = int(stream.jobs_done)
-            if (checkpoint and checkpoint_every
-                    and int(stream.steps) % int(checkpoint_every) == 0):
-                save_state(checkpoint, (state, stream),
-                           meta={"stream_steps": int(stream.steps),
-                                 "jobs_done": done})
-                saves += 1
-                if kill_after_saves is not None \
-                        and saves >= int(kill_after_saves):
-                    return state, stream
-            if done >= jcount:
-                return state, stream
-        raise RuntimeError(
-            f"run_stream: {jcount - done} of {jcount} jobs unfinished after "
-            f"{max_steps} steps — raise max_steps (or a lane is stuck, "
-            f"which the stage machine should make impossible)")
+        done = int(stream.jobs_done)
+        if done < target:
+            for _ in range(int(max_steps)):
+                if memo == "off":
+                    state, stream = step(state, stream, pool_dev)
+                else:
+                    state, stream = step(state, stream, pool_dev,
+                                         order_dev, followers_dev)
+                if ff:
+                    state, stream = self._ff_host(state, stream, pool,
+                                                  ff_seen)
+                done = int(stream.jobs_done)
+                if (checkpoint and checkpoint_every
+                        and int(stream.steps) % int(checkpoint_every) == 0):
+                    save_state(checkpoint, (state, stream),
+                               meta={"stream_steps": int(stream.steps),
+                                     "jobs_done": done})
+                    saves += 1
+                    if kill_after_saves is not None \
+                            and saves >= int(kill_after_saves):
+                        return state, stream
+                if done >= target:
+                    break
+            else:
+                raise RuntimeError(
+                    f"run_stream: {target - done} of {target} executed jobs "
+                    f"unfinished after {max_steps} steps — raise max_steps "
+                    f"(or a lane is stuck, which the stage machine should "
+                    f"make impossible)")
+        if memo != "off":
+            state, stream = self._memo_finalize(state, stream, plan)
+        return state, stream
 
-    @staticmethod
-    def stream_results(stream: StreamState) -> List[dict]:
-        """The results ring as host-side per-job rows, sorted by job id
+    def stream_results(self, stream: StreamState) -> List[dict]:
+        """The results as host-side per-job rows, sorted by job id
         (completion order is admission-dependent; the sort makes
-        stream-vs-static comparison direct). A ring smaller than the job
-        count keeps only the newest rows — the oldest ``res_count -
-        capacity`` are evicted; summarize_stream reports the count."""
-        from chandy_lamport_tpu.core.state import decode_error_bits
-
-        host = jax.device_get(stream)
-        rcap = int(np.shape(host.res_job)[0])
-        rows = []
-        for i in range(min(int(host.res_count), rcap)):
-            err = int(host.res_error[i])
-            rows.append({
-                "job": int(host.res_job[i]),
-                "time": int(host.res_time[i]),
-                "error": err,
-                "errors_decoded": decode_error_bits(err),
-                "snapshots_started": int(host.res_snap_started[i]),
-                "snapshots_completed": int(host.res_snap_completed[i]),
-                "snapshots_failed": int(host.res_snap_failed[i]),
-                "fault_skew": int(host.res_fault_skew[i]),
-                "fault_events": int(host.res_fault_events[i]),
-                "admit_step": int(host.res_admit_step[i]),
-                "tokens": np.asarray(host.res_tokens[i]).astype(int).tolist(),
-            })
-        rows.sort(key=lambda r: r["job"])
-        return rows
+        stream-vs-static comparison direct): the harvested ring overlaid
+        with the rows the memo plane served without execution (those
+        carry ``digest`` + ``served_from`` provenance keys and
+        ``admit_step`` -1). A ring smaller than the executed-job count
+        keeps only the newest rows — the oldest ``res_count - capacity``
+        are evicted; summarize_stream reports the count."""
+        rows = {r["job"]: r for r in _ring_rows(stream)}
+        rows.update(getattr(self, "_memo_rows", None) or {})
+        return sorted(rows.values(), key=lambda r: r["job"])
 
     def summarize_stream(self, stream: StreamState) -> dict:
         """Host-side stream counters (utils/metrics.stream_counters:
@@ -1127,7 +1602,8 @@ class BatchedRunner:
     #    axis these lower to XLA collectives over ICI) --------------------
 
     @staticmethod
-    def summarize(state: DenseState) -> dict:
+    def summarize(state: DenseState, stream: Optional[StreamState] = None
+                  ) -> dict:
         from chandy_lamport_tpu.core.state import decode_error_bits
         from chandy_lamport_tpu.utils.metrics import (
             or_reduce,
@@ -1138,7 +1614,7 @@ class BatchedRunner:
         bits = int(or_reduce(state.error))
         fc = jnp.sum(state.fault_counts, axis=0)
         tr_rec, tr_drop = trace_counts(state)
-        return {
+        out = {
             "instances": int(state.time.shape[0]),
             "total_ticks": int(jnp.sum(state.time)),
             "max_time": int(jnp.max(state.time)),
@@ -1178,3 +1654,13 @@ class BatchedRunner:
                 k: int(v) for k, v in snapshot_lifecycle(
                     state, state.has_local.shape[-1]).items()},
         }
+        if stream is not None:
+            # memo-plane accounting rides along when the caller passes the
+            # stream carry (utils/metrics.stream_counters does the math)
+            from chandy_lamport_tpu.utils.metrics import stream_counters
+
+            sc = stream_counters(jax.device_get(stream))
+            out["memo"] = {k: sc[k] for k in (
+                "cache_hits", "coalesced_jobs", "ff_skipped_ticks",
+                "shadow_checks", "memo_hit_rate")}
+        return out

@@ -1501,6 +1501,8 @@ class GraphShardedRunner:
             job_id=np.int32(-1),
             prog_cursor=np.int32(0),
             admit_tick=np.int32(0),
+            # no memo plane on the sharded runner either
+            sig=np.uint32(0),
             error=np.asarray(h.error),
         )
 

@@ -1,0 +1,218 @@
+"""Content-addressed job-summary memoization (the memo plane's host side).
+
+"Supercharging Packet-level Network Simulation via Memoization and
+Fast-Forwarding" (PAPERS.md) observes that production traffic repeats a
+small scenario library, so the biggest multiplier is not ticking faster
+but not ticking at all: content-address whole jobs and serve exact
+repeats from a summary cache. This module is that cache plus the digest
+recipe and the ``memo`` knob resolution; the lane-coalescing and
+transition fast-forwarding halves live in ``parallel/batch.py``.
+
+**Digest recipe** (``job_digest``): sha256 over a canonical JSON
+encoding of everything that determines a job's summary bit-for-bit —
+the topology spec (sorted node ids + balances + sorted links), the
+job's compiled script rows (kind/arg0/arg1/do_tick), its fault
+adversary key, its delay-sampler state row, the scheduler, the RESOLVED
+engine knobs (queue/comm/kernel — "auto" is resolved before hashing so
+a digest means the same thing on every backend), and the
+semantics-affecting SimConfig fields (everything except
+``trace_capacity``, which changes only observability). Two jobs with
+equal digests run the identical jitted computation on identical
+operands, so their summaries are interchangeable.
+
+**Cache file format**: JSON lines, one entry per line —
+``{"schema": MEMOCACHE_SCHEMA_VERSION, "digest": <64 hex>,
+"summary": {...}}`` — content-addressed by digest (last write wins on
+re-insert). Discipline mirrors utils/checkpoint.py, not the lenient
+telemetry reader: writes are atomic (tmp-then-``os.replace``, tmp
+unlinked on any failure), and a load REJECTS a poisoned, truncated or
+stale-schema file with ``MemoCacheError`` naming the path — a cache
+that silently skipped a torn line could silently serve a stale summary
+forever.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from chandy_lamport_tpu.config import ENGINE_KNOBS
+
+# THE memocache schema version: one named registry constant, bumped on
+# any breaking change of the cache line layout or the digest recipe (a
+# recipe change MUST bump it — old digests would alias different
+# computations). tools/staticcheck's memo-schema rule pins this to a
+# single int-literal assignment here and keeps restated literals out of
+# the schema-stamping dicts below.
+MEMOCACHE_SCHEMA_VERSION = 1
+
+_DIGEST_HEX_LEN = 64   # sha256
+
+
+class MemoCacheError(ValueError):
+    """A memo cache file could not be read or validated, or a shadow
+    re-execution contradicted a served summary. Always carries the path
+    (or the digest, for shadow mismatches); raised instead of silently
+    skipping damage — a summary cache that guesses serves stale answers
+    forever."""
+
+
+def resolve_memo(memo: str) -> str:
+    """Validate the ``memo`` engine knob (config.ENGINE_KNOBS). Unlike
+    the backend-resolved knobs there is no "auto": the spellings are an
+    explicit opt-in ladder (off < admit < full), so resolution is pure
+    validation."""
+    allowed = ENGINE_KNOBS["memo"]
+    if memo not in allowed:
+        raise ValueError(
+            f"memo must be one of {', '.join(map(repr, allowed))}, "
+            f"got {memo!r}")
+    return memo
+
+
+def _canon(x: Any) -> Any:
+    """Canonical JSON-able form of a digest ingredient: numpy arrays
+    become (dtype, shape, values) triples, scalars become python ints/
+    floats, tuples become lists — stable across processes and numpy
+    versions (json.dumps with sort_keys does the rest)."""
+    if isinstance(x, np.ndarray):
+        return ["ndarray", str(x.dtype), list(x.shape),
+                x.reshape(-1).tolist()]
+    if isinstance(x, (np.integer,)):
+        return int(x)
+    if isinstance(x, (np.floating,)):
+        return float(x)
+    if isinstance(x, (list, tuple)):
+        return [_canon(v) for v in x]
+    if isinstance(x, dict):
+        return {str(k): _canon(v) for k, v in sorted(x.items())}
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    # last resort: a stable repr (delay treedefs reach here as strings
+    # already; anything else unexpected still digests deterministically)
+    return repr(x)
+
+
+def job_digest(*, topo_spec, script, fault_key, delay_row, scheduler: str,
+               knobs: Dict[str, str], config_fields: Dict[str, Any]) -> str:
+    """The content address of one stream job (module docstring recipe).
+
+    ``topo_spec`` is a utils.fixtures.TopologySpec; ``script`` the job's
+    compiled (kind, arg0, arg1, do_tick) row arrays; ``delay_row`` the
+    job's delay-sampler state pytree (leaves + treedef string);
+    ``knobs`` the RESOLVED engine knob spellings; ``config_fields`` the
+    semantics-affecting SimConfig fields. Every ingredient goes through
+    the canonical encoding so the digest is process- and
+    platform-stable.
+    """
+    payload = {
+        "schema": MEMOCACHE_SCHEMA_VERSION,
+        "nodes": _canon(sorted((str(k), int(v)) for k, v in topo_spec.nodes)),
+        "links": _canon(sorted((str(s), str(d)) for s, d in topo_spec.links)),
+        "script": _canon(list(script)),
+        "fault_key": _canon(fault_key),
+        "delay_row": _canon(delay_row),
+        "scheduler": str(scheduler),
+        "knobs": _canon(knobs),
+        "config": _canon(config_fields),
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class SummaryCache:
+    """The persistent content-addressed summary store (module docstring
+    format). In-memory dict keyed by digest; ``load`` is strict,
+    ``flush`` is atomic. An entry's summary is the per-job result row as
+    plain JSON scalars/lists (parallel/batch.stream_results row minus
+    the job index, which is pool-relative, plus the producer's digest so
+    telemetry can prove provenance)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._entries: Dict[str, dict] = {}
+        self._dirty = False
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except OSError as exc:
+            raise MemoCacheError(
+                f"memo cache {path}: unreadable ({exc})") from exc
+        for lineno, line in enumerate(raw.splitlines(), start=1):
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError as exc:
+                raise MemoCacheError(
+                    f"memo cache {path}: line {lineno} is not valid JSON "
+                    f"(poisoned or truncated write: {exc})") from exc
+            if not isinstance(entry, dict) or not {
+                    "schema", "digest", "summary"} <= set(entry):
+                raise MemoCacheError(
+                    f"memo cache {path}: line {lineno} is missing the "
+                    f"schema/digest/summary keys — not a memo cache entry")
+            if entry["schema"] != MEMOCACHE_SCHEMA_VERSION:
+                raise MemoCacheError(
+                    f"memo cache {path}: line {lineno} has schema version "
+                    f"{entry['schema']!r}; this build reads only "
+                    f"v{MEMOCACHE_SCHEMA_VERSION} (a schema bump changes "
+                    f"the digest recipe — stale entries must not be "
+                    f"served; delete the file to rebuild it)")
+            digest = entry["digest"]
+            if (not isinstance(digest, str)
+                    or len(digest) != _DIGEST_HEX_LEN
+                    or any(c not in "0123456789abcdef" for c in digest)):
+                raise MemoCacheError(
+                    f"memo cache {path}: line {lineno} digest "
+                    f"{digest!r} is not a sha256 hex string")
+            if not isinstance(entry["summary"], dict):
+                raise MemoCacheError(
+                    f"memo cache {path}: line {lineno} summary is not an "
+                    f"object")
+            self._entries[digest] = entry["summary"]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, digest: str) -> bool:
+        return digest in self._entries
+
+    def get(self, digest: str) -> Optional[dict]:
+        return self._entries.get(digest)
+
+    def put(self, digest: str, summary: dict) -> None:
+        self._entries[digest] = summary
+        self._dirty = True
+
+    def flush(self) -> None:
+        """Atomically persist every entry (tmp-then-``os.replace``,
+        checkpoint.py discipline): a kill at any instant leaves either
+        the previous complete file or the new complete file, never a
+        torn one. No-op without a path or pending writes."""
+        if self.path is None or not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for digest, summary in self._entries.items():
+                    f.write(json.dumps(
+                        {"schema": MEMOCACHE_SCHEMA_VERSION,
+                         "digest": digest, "summary": summary},
+                        sort_keys=True) + "\n")
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

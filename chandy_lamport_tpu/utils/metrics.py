@@ -159,15 +159,31 @@ def stream_counters(stream) -> Dict[str, Any]:
     straggler-wasted lane-steps the occupancy complement counts."""
     total = int(stream.lane_steps_total)
     live = int(stream.lane_steps_live)
+    done = int(stream.jobs_done)
+    hits = int(stream.cache_hits)
+    coalesced = int(stream.coalesced_jobs)
+    served = done + hits + coalesced
     return {
         "steps": int(stream.steps),
         "jobs_admitted": int(stream.next_job),
-        "jobs_done": int(stream.jobs_done),
+        "jobs_done": done,
         "refills": int(stream.refills),
         "occupancy": round(live / total, 4) if total else 0.0,
         "lane_steps_live": live,
         "lane_steps_total": total,
         "straggler_wasted_steps": total - live,
+        # memo plane (parallel/batch memo="admit|full"): jobs served from
+        # the persistent summary cache without burning a lane, duplicate
+        # jobs coalesced onto a representative lane, ticks the signature
+        # fast-forward credited instead of re-ticking, and shadow
+        # re-executions that proved a served summary bit-exact. The hit
+        # rate is (cache + coalesce) over everything served — 0.0 with
+        # memo="off".
+        "cache_hits": hits,
+        "coalesced_jobs": coalesced,
+        "ff_skipped_ticks": int(stream.ff_skipped_ticks),
+        "shadow_checks": int(stream.shadow_checks),
+        "memo_hit_rate": round((hits + coalesced) / served, 4) if served else 0.0,
     }
 
 
@@ -177,16 +193,17 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
     the capacity-planning formula behind BASELINE.md's max-batch numbers.
 
     footprint = 8·E·C + (24 + rec·L)·E + 4·N + S·(22 + 10·N + (10+2·win)·E)
-                + 12·K + 8
+                + 12·K + 12
     with rec = itemsize of SimConfig.record_dtype (4 default, 2 for int16),
     win = itemsize of SimConfig.window_dtype (4 default, 2 for uint16),
     and L = cfg.max_recorded (shared per-edge log slots). The 8·E·C term
     is the two packed int32 ring planes (q_meta = rtime<<1|marker, q_data;
     core/state.py "Packed ring slots" — the former separate bool marker
-    plane is folded into q_meta). The 12·K + 8 term is the flight-recorder
-    ring (three i32 planes of K = cfg.trace_capacity slots plus the
-    tr_count / tr_on scalars, utils/tracing.py); the default trace-off
-    configuration pays only the 8 counter bytes (K = 0).
+    plane is folded into q_meta). The 12·K + 12 term is the
+    flight-recorder ring (three i32 planes of K = cfg.trace_capacity
+    slots plus the tr_count / tr_on scalars, utils/tracing.py) and the
+    memo plane's u32 ``sig`` signature scalar; the default trace-off
+    configuration pays only the 12 counter bytes (K = 0).
 
     Dominant terms at bench shapes are the [S, E] recording/window/marker
     planes and the per-edge log ``log_amt[L, E]`` — size S and L to the
@@ -211,9 +228,9 @@ def instance_footprint_bytes(num_nodes: int, num_edges: int,
                  + e * (1 + win * 2) + e * (1 + 4 + 4)
                  + 5 * 4 + 1)
     # time/next_sid/error + fault_key/fault_skew/fault_counts[7] +
-    # stale_markers, completed, and the streaming-engine job identity
-    # (job_id/prog_cursor/admit_tick)
-    scalars = 4 * 3 + 4 * 10 + s * 4 + 4 * 3
+    # stale_markers, completed, the streaming-engine job identity
+    # (job_id/prog_cursor/admit_tick), and the memo plane's sig scalar
+    scalars = 4 * 3 + 4 * 10 + s * 4 + 4 * 3 + 4
     # flight-recorder ring: tr_meta/tr_data/tr_tick[K] + tr_count/tr_on
     trace = 12 * cfg.trace_capacity + 8
     return queues + nodes + rec_log + snaps + scalars + trace

@@ -109,13 +109,16 @@ EV_FAULT = 9         # payload = FC_* class            actor = edge (node for
 #                                                      FC_CRASH)
 EV_LANE_ADMIT = 10   # payload = job id                actor = 0
 EV_LANE_HARVEST = 11  # payload = job id               actor = 0
+EV_LANE_COALESCE = 12  # payload = follower count      actor = 0
+EV_MEMO_HIT = 13     # payload = ticks fast-forwarded  actor = 0
 
 EVENT_KIND_NAMES = (
     "send", "recv", "marker-send", "marker-recv", "snapshot-start",
     "snapshot-end", "supervisor-abort", "supervisor-retry",
-    "supervisor-fail", "fault", "lane-admit", "lane-harvest")
+    "supervisor-fail", "fault", "lane-admit", "lane-harvest",
+    "lane-coalesce", "memo-hit")
 
-_KIND_BITS = 5          # 12 kinds defined, headroom to 31
+_KIND_BITS = 5          # 14 kinds defined, headroom to 31
 _KIND_MASK = (1 << _KIND_BITS) - 1
 
 
@@ -329,6 +332,10 @@ def _event_line(ev: TraceRecord, topo) -> str:
         return f"\tlane: admit(job {ev.payload})"
     if ev.kind == EV_LANE_HARVEST:
         return f"\tlane: harvest(job {ev.payload})"
+    if ev.kind == EV_LANE_COALESCE:
+        return f"\tlane: coalesce({ev.payload} followers)"
+    if ev.kind == EV_MEMO_HIT:
+        return f"\tlane: memo-hit(fast-forwarded {ev.payload} ticks)"
     return f"\t?: {ev.kind_name}({ev.payload})"
 
 

@@ -48,3 +48,26 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: exceeds the tier-1 time budget "
                    "(deselected by -m 'not slow')")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ring8_sync_stream_runner():
+    """ONE compiled ring-8 sync streaming runner shared across test files
+    (test_stream.py, test_memo.py): both drive the identical (topology,
+    config, delay, batch) shape, and the jitted stream step is among the
+    most expensive compiles in the tier-1 gate — module-scoped copies
+    paid it once per file. Runner jit caches live on the instance, so
+    sharing the instance is what shares the compile. Tests must not
+    mutate the runner (memo/memo_cache arms build their own)."""
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+
+    return BatchedRunner(
+        ring_topology(8), SimConfig.for_workload(snapshots=4,
+                                                 max_recorded=128),
+        make_fast_delay("hash", 11), 4, scheduler="sync")

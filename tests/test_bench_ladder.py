@@ -299,6 +299,32 @@ def test_worker_row_round_trips_kernel_engine(engine, capsys):
     assert row["value"] > 0
 
 
+def test_stream_worker_row_round_trips_memo_books(capsys):
+    """A real (tiny, CPU) --stream --worker A/B under the memo plane: the
+    row must carry the memo knob, the dup mix, the coalesce/cache/
+    fast-forward books and BOTH throughputs (memoized effective vs the
+    memo-off baseline measured in the same process), so a duplicate-heavy
+    BENCH row can never pass a memoized number off as raw execution."""
+    rc = bench.main(["--worker", "--stream", "--graph", "ring",
+                     "--nodes", "8", "--batch", "2", "--jobs", "8",
+                     "--snapshots", "2", "--repeats", "1",
+                     "--dup-rate", "0.5", "--memo", "full"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["metric"] == "stream_jobs_per_sec"
+    assert row["memo"] == "full" and row["dup_rate"] == 0.5
+    assert row["coalesced_jobs"] > 0
+    assert row["cache_hits"] == 0  # no --memo-cache: nothing from file
+    assert row["ff_skipped_ticks"] >= 0 and row["shadow_checks"] >= 1
+    assert 0.0 < row["memo_hit_rate"] < 1.0
+    assert row["effective_jobs_per_sec"] > 0
+    assert row["effective_jobs_per_sec_off"] > 0
+    assert row["memo_speedup"] == pytest.approx(
+        row["effective_jobs_per_sec"] / row["effective_jobs_per_sec_off"],
+        rel=1e-2)
+
+
 @pytest.mark.slow
 def test_graphshard_worker_row_round_trips_kernel_engine(capsys):
     """The graph-sharded worker row carries kernel_engine too (from

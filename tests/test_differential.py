@@ -47,9 +47,11 @@ def test_parity_vs_dense_random(case_seed):
 
 
 @pytest.mark.parametrize("case_seed", [
-    0, 1,
-    # half the seed battery rides tier-1; the rest runs in full passes
-    # (tier-1 wall-clock budget — each seed is a ~8 s compile+run pair)
+    0,
+    # seed 0 rides tier-1; the rest of the battery runs in full passes
+    # (tier-1 wall-clock budget — each seed is a ~8 s compile+run pair;
+    # seed 1 moved out when the memo-plane tests joined the gate)
+    pytest.param(1, marks=pytest.mark.slow),
     pytest.param(2, marks=pytest.mark.slow),
     pytest.param(3, marks=pytest.mark.slow)])
 def test_cascade_vs_fold_exact_impls(case_seed):

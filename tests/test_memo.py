@@ -1,0 +1,285 @@
+"""Memo plane (content-addressed admission + fast-forwarding): bit-exactness.
+
+The contract under test (parallel/batch docstring, utils/memocache): with
+``memo != 'off'`` every job's served summary — whether executed, coalesced
+onto a duplicate's lane, or read back from the persistent cache — is
+BIT-IDENTICAL to the row the same pool produces under ``memo='off'``. The
+oracle is therefore the memo-off run of the SAME content-keyed pool (the
+pool, not the job list: index-keyed pools give byte-identical scripts
+distinct fault/delay streams, so the A/B must share one pack).
+
+Tier-1 keeps one tiny ring-8 pool with a Zipf duplicate mix and shares
+module-scoped runners so each jitted stream step compiles once; the
+fast-forward check uses the 2-node one-link livelock (a snapshot on the
+sink can never complete, so the drain grinds to ERR_TICK_LIMIT through
+thousands of pure +1 ticks — exactly what memo='full' jumps). The deep
+fault-armed sweep over both schedulers is ``slow``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import SnapshotEvent
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import ring_topology, stream_jobs
+from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.fixtures import TopologySpec
+from chandy_lamport_tpu.utils.memocache import (
+    MEMOCACHE_SCHEMA_VERSION,
+    MemoCacheError,
+    SummaryCache,
+)
+
+TOPO = ring_topology(8)
+CFG = SimConfig.for_workload(snapshots=4, max_recorded=128)
+J, B = 10, 4
+NUNIQ = 4  # J=10 at dup_rate 0.6 -> a 4-scenario library + 6 repeats
+
+
+def _delay():
+    return make_fast_delay("hash", 11)
+
+
+def _jobs():
+    return stream_jobs(TOPO, J, seed=5, base_phases=3, max_phases=12,
+                       dup_rate=0.6)
+
+
+def _strip(rows):
+    """Drop the admission- and provenance-dependent keys: everything left
+    must be bit-identical between the memo arms and the off oracle."""
+    return [{k: v for k, v in r.items()
+             if k not in ("admit_step", "digest", "served_from")}
+            for r in rows]
+
+
+@pytest.fixture(scope="module")
+def off_runner(ring8_sync_stream_runner):
+    # the session-scoped shared instance (conftest): same (TOPO, CFG,
+    # delay, B) shape as declared above — the memo-off oracle rides the
+    # stream step test_stream.py already compiled
+    return ring8_sync_stream_runner
+
+
+@pytest.fixture(scope="module")
+def pool(off_runner):
+    # ONE content-keyed pool shared by every arm — the memo plane requires
+    # content keys, and the off oracle must run the identical operands
+    return off_runner.pack_jobs(_jobs(), content_keys=True)
+
+
+@pytest.fixture(scope="module")
+def off_rows(off_runner, pool):
+    _, stream = off_runner.run_stream(pool, stretch=3, drain_chunk=16)
+    return off_runner.stream_results(stream)
+
+
+@pytest.fixture(scope="module")
+def admit_runner(tmp_path_factory):
+    cache = str(tmp_path_factory.mktemp("memo") / "summaries.jsonl")
+    return BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync",
+                         memo="admit", memo_cache=cache)
+
+
+def test_duplicate_jobs_share_digests(pool):
+    digests = {bytes(pool.digest[j].tobytes()) for j in range(J)}
+    assert len(digests) == NUNIQ
+    assert all(d != b"\x00" * 32 for d in digests)
+
+
+def test_digest_changes_with_execution_identity(off_runner, pool):
+    # a different scheduler is a different computation: nothing may alias
+    exact = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="exact")
+    pool2 = exact.pack_jobs(_jobs(), content_keys=True)
+    assert not np.array_equal(np.asarray(pool.digest),
+                              np.asarray(pool2.digest))
+    # and a different job mix yields different addresses
+    other = off_runner.pack_jobs(
+        stream_jobs(TOPO, J, seed=6, base_phases=3, max_phases=12,
+                    dup_rate=0.6), content_keys=True)
+    assert not np.array_equal(np.asarray(pool.digest),
+                              np.asarray(other.digest))
+
+
+def test_digest_stable_across_processes(pool):
+    # the cache is only sound if the address survives a process boundary
+    code = (
+        "from chandy_lamport_tpu.config import SimConfig\n"
+        "from chandy_lamport_tpu.models.workloads import ring_topology, "
+        "stream_jobs\n"
+        "from chandy_lamport_tpu.ops.delay_jax import make_fast_delay\n"
+        "from chandy_lamport_tpu.parallel.batch import BatchedRunner\n"
+        "r = BatchedRunner(ring_topology(8), "
+        "SimConfig.for_workload(snapshots=4, max_recorded=128), "
+        "make_fast_delay('hash', 11), 4, scheduler='sync')\n"
+        "jobs = stream_jobs(ring_topology(8), 10, seed=5, base_phases=3, "
+        "max_phases=12, dup_rate=0.6)\n"
+        "p = r.pack_jobs(jobs, content_keys=True)\n"
+        "print(bytes(p.digest[0].tobytes()).hex())\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "True"}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().splitlines()[-1] == \
+        bytes(pool.digest[0].tobytes()).hex()
+
+
+@pytest.fixture(scope="module")
+def coalesced_run(admit_runner, pool):
+    """First (cold-cache) admit run: coalesces duplicates and flushes the
+    persistent cache — the warm-cache test reads the file it leaves."""
+    _, stream = admit_runner.run_stream(pool, stretch=3, drain_chunk=16)
+    return (admit_runner.stream_results(stream),
+            admit_runner.summarize_stream(stream))
+
+
+def test_coalesced_rows_bit_identical_to_off(coalesced_run, off_rows):
+    rows, summ = coalesced_run
+    assert _strip(rows) == _strip(off_rows)
+    assert summ["coalesced_jobs"] == J - NUNIQ
+    assert summ["cache_hits"] == 0  # cold cache: nothing served from file
+    assert summ["shadow_checks"] >= 1
+    served = [r for r in rows if r.get("served_from")]
+    assert len(served) == J - NUNIQ
+    assert all(r["served_from"] == "coalesce" and r["admit_step"] == -1
+               and len(r["digest"]) == 64 for r in served)
+
+
+def test_warm_cache_serves_across_runs(admit_runner, pool, off_rows,
+                                       coalesced_run):
+    # the cold run flushed the cache file; a second run of the same
+    # pool must serve every job either from file or as the shadow audit
+    assert os.path.exists(admit_runner.memo_cache_path)
+    _, stream = admit_runner.run_stream(pool, stretch=3, drain_chunk=16)
+    rows = admit_runner.stream_results(stream)
+    assert _strip(rows) == _strip(off_rows)
+    summ = admit_runner.summarize_stream(stream)
+    assert summ["cache_hits"] > 0
+    assert summ["cache_hits"] + summ["coalesced_jobs"] \
+        + summ["shadow_checks"] >= J
+
+
+def test_kill_and_resume_replans_identically(admit_runner, pool, off_rows,
+                                             tmp_path):
+    # a killed memo run resumes bit-exactly: the admission plan is a pure
+    # function of (pool, cache file) and the cache only flushes at run END,
+    # so the resumed process re-derives the same plan, finishes the
+    # executed jobs, and serves the same summaries
+    old_cache = admit_runner.memo_cache_path
+    admit_runner.memo_cache_path = str(tmp_path / "cold.jsonl")
+    try:
+        ckpt = str(tmp_path / "memo_stream.npz")
+        _, killed = admit_runner.run_stream(pool, stretch=3, drain_chunk=16,
+                                            checkpoint=ckpt,
+                                            checkpoint_every=2,
+                                            kill_after_saves=2)
+        assert int(killed.jobs_done) < NUNIQ + 1, \
+            "kill landed after the queue drained — shrink checkpoint_every"
+        from chandy_lamport_tpu.utils.checkpoint import load_state
+        like = (admit_runner.init_batch(), admit_runner.init_stream(pool))
+        (state, stream), _meta = load_state(ckpt, like)
+        _, stream = admit_runner.run_stream(pool, stretch=3, drain_chunk=16,
+                                            state=state, stream=stream)
+        assert _strip(admit_runner.stream_results(stream)) \
+            == _strip(off_rows)
+    finally:
+        admit_runner.memo_cache_path = old_cache
+
+
+def test_fast_forward_skips_livelocked_drain():
+    # two nodes, ONE link a->b: a snapshot initiated at the sink can never
+    # reach "a", so the drain runs pure +1 ticks to ERR_TICK_LIMIT — the
+    # exact recurrence memo='full' detects and jumps in one step
+    spec = TopologySpec(nodes=[("a", 10), ("b", 10)], links=[("a", "b")])
+    cfg = dataclasses.replace(
+        SimConfig.for_workload(snapshots=2, max_recorded=32), max_ticks=600)
+    jobs = [[SnapshotEvent("b")]] * 3
+    r_off = BatchedRunner(spec, cfg, _delay(), 2, scheduler="exact")
+    pool = r_off.pack_jobs(jobs, content_keys=True)
+    _, s_off = r_off.run_stream(pool, stretch=2, drain_chunk=16)
+    r_full = BatchedRunner(spec, cfg, _delay(), 2, scheduler="exact",
+                           memo="full")
+    _, s_full = r_full.run_stream(pool, stretch=2, drain_chunk=16)
+    assert _strip(r_full.stream_results(s_full)) \
+        == _strip(r_off.stream_results(s_off))
+    summ = r_full.summarize_stream(s_full)
+    assert summ["ff_skipped_ticks"] > 0
+    # the jump replaces drain slices wholesale, never adds steps
+    assert int(s_full.steps) < int(s_off.steps)
+
+
+@pytest.mark.parametrize("poison, excerpt", [
+    ("{not json", "not valid JSON"),
+    ('{"digest": "ab", "summary": {}}\n', "missing the"),
+    ('{"schema": 99, "digest": "%s", "summary": {}}\n' % ("a" * 64),
+     "schema version 99"),
+    ('{"schema": %d, "digest": "zz", "summary": {}}\n'
+     % MEMOCACHE_SCHEMA_VERSION, "not a sha256 hex string"),
+    ('{"schema": %d, "digest": "%s", "summary": 7}\n'
+     % (MEMOCACHE_SCHEMA_VERSION, "b" * 64), "summary is not an"),
+])
+def test_damaged_cache_is_rejected_loudly(tmp_path, poison, excerpt):
+    path = tmp_path / "cache.jsonl"
+    path.write_text(poison)
+    with pytest.raises(MemoCacheError, match=excerpt):
+        SummaryCache(str(path))
+
+
+def test_runner_refuses_damaged_cache(admit_runner, pool, tmp_path):
+    # the rejection reaches the runner: a poisoned file fails the run
+    # up front instead of silently serving stale or garbled summaries
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"schema": 99, "digest": "%s", "summary": {}}\n'
+                   % ("c" * 64))
+    old_cache = admit_runner.memo_cache_path
+    admit_runner.memo_cache_path = str(bad)
+    try:
+        with pytest.raises(MemoCacheError, match="schema version 99"):
+            admit_runner.run_stream(pool, stretch=3, drain_chunk=16)
+    finally:
+        admit_runner.memo_cache_path = old_cache
+
+
+def test_memo_requires_content_keyed_pool(admit_runner):
+    # an index-keyed pool has no digests; admitting it under memo would
+    # coalesce jobs that run DIFFERENT fault/delay streams
+    off = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync")
+    plain = off.pack_jobs(_jobs(), content_keys=False)
+    with pytest.raises(ValueError, match="content-addressed"):
+        admit_runner.run_stream(plain, stretch=3, drain_chunk=16)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("sched", ["exact", "sync"])
+def test_memo_full_deep_sweep_with_faults(sched, tmp_path):
+    # the acceptance sweep: heavy-tailed duplicate mix with the fault
+    # adversary armed on every third job, memo='full' vs 'off' on the
+    # shared content-keyed pool — every served row bit-identical
+    jcount, slots = 24, 8
+    faults = JaxFaults(7, drop_rate=0.05, dup_rate=0.05,
+                       max_delay=_delay().max_delay)
+    jobs = stream_jobs(TOPO, jcount, seed=6, base_phases=3, max_phases=16,
+                       dup_rate=0.5)
+    armed = np.arange(jcount) % 3 == 0
+    r_off = BatchedRunner(TOPO, CFG, _delay(), slots, scheduler=sched,
+                          faults=faults, quarantine=True)
+    pool = r_off.pack_jobs(jobs, fault_armed=armed, content_keys=True)
+    _, s_off = r_off.run_stream(pool, stretch=4, drain_chunk=16)
+    r_memo = BatchedRunner(TOPO, CFG, _delay(), slots, scheduler=sched,
+                           faults=faults, quarantine=True, memo="full",
+                           memo_cache=str(tmp_path / f"{sched}.jsonl"))
+    _, s_memo = r_memo.run_stream(pool, stretch=4, drain_chunk=16)
+    assert _strip(r_memo.stream_results(s_memo)) \
+        == _strip(r_off.stream_results(s_off))
+    summ = r_memo.summarize_stream(s_memo)
+    assert summ["coalesced_jobs"] > 0

@@ -65,6 +65,95 @@ def test_err_bit_registry_catches_name_bit_mismatch():
         [v.detail for v in vs]
 
 
+def test_ckpt_history_rejects_version_gap():
+    # a v8 row appended as v9 (or any gap) breaks the consecutive-from-1
+    # contract the supported-range error message relies on
+    sources = {ast_lint.STATE_PATH: (
+        "CHECKPOINT_FORMAT_HISTORY = (\n"
+        "    (1, \"genesis\"),\n"
+        "    (2, \"quarantine\"),\n"
+        "    (9, \"memo plane\"),\n"
+        ")\n"
+        "CHECKPOINT_FORMAT_VERSION = CHECKPOINT_FORMAT_HISTORY[-1][0]\n"
+    )}
+    vs = ast_lint.check_ckpt_versions(sources)
+    assert any(v.rule == "ckpt-history" and "expected 3" in v.detail
+               for v in vs), [v.detail for v in vs]
+
+
+_MEMO_KNOB_OK = (
+    "ENGINE_KNOBS = {\n"
+    "    \"memo\": (\"off\", \"admit\", \"full\"),\n"
+    "}\n"
+)
+_RESOLVE_MEMO_OK = (
+    "from chandy_lamport_tpu.config import ENGINE_KNOBS\n"
+    "def resolve_memo(memo):\n"
+    "    if memo not in ENGINE_KNOBS[\"memo\"]:\n"
+    "        raise ValueError(memo)\n"
+    "    return memo\n"
+)
+
+
+def test_memo_knob_requires_table_row_and_ladder_order():
+    # missing row
+    vs = ast_lint.check_memo_knob({
+        ast_lint.CONFIG_PATH: "ENGINE_KNOBS = {\"scheduler\": (\"sync\",)}\n",
+        "chandy_lamport_tpu/utils/memocache.py": _RESOLVE_MEMO_OK})
+    assert any("no 'memo' row" in v.detail for v in vs), \
+        [v.detail for v in vs]
+    # row present but ladder reordered: off must lead
+    vs = ast_lint.check_memo_knob({
+        ast_lint.CONFIG_PATH:
+            "ENGINE_KNOBS = {\"memo\": (\"full\", \"admit\", \"off\")}\n",
+        "chandy_lamport_tpu/utils/memocache.py": _RESOLVE_MEMO_OK})
+    assert any("'off' leads" in v.detail for v in vs), [v.detail for v in vs]
+    # the clean shape passes
+    assert ast_lint.check_memo_knob({
+        ast_lint.CONFIG_PATH: _MEMO_KNOB_OK,
+        "chandy_lamport_tpu/utils/memocache.py": _RESOLVE_MEMO_OK}) == []
+
+
+def test_memo_knob_rejects_inline_spelling_copy():
+    bad_resolver = (
+        "def resolve_memo(memo):\n"
+        "    if memo not in (\"off\", \"admit\", \"full\"):\n"
+        "        raise ValueError(memo)\n"
+        "    return memo\n"
+    )
+    vs = ast_lint.check_memo_knob({
+        ast_lint.CONFIG_PATH: _MEMO_KNOB_OK,
+        "chandy_lamport_tpu/utils/memocache.py": bad_resolver})
+    details = [v.detail for v in vs]
+    assert any("does not consult ENGINE_KNOBS" in d for d in details), details
+    assert any("restates the memo spellings inline" in d
+               for d in details), details
+
+
+def test_memo_schema_single_named_constant():
+    # restated literal in a schema-stamping dict
+    vs = ast_lint.check_memo_schema({ast_lint.MEMOCACHE_PATH: (
+        "MEMOCACHE_SCHEMA_VERSION = 1\n"
+        "def put():\n"
+        "    return {\"schema\": 1, \"digest\": \"d\"}\n"
+    )})
+    assert any("restated literal 1" in v.detail for v in vs), \
+        [v.detail for v in vs]
+    # re-assignment outside memocache.py
+    vs = ast_lint.check_memo_schema({
+        ast_lint.MEMOCACHE_PATH: "MEMOCACHE_SCHEMA_VERSION = 1\n",
+        "chandy_lamport_tpu/parallel/batch.py":
+            "MEMOCACHE_SCHEMA_VERSION = 2\n"})
+    assert any("lives only in utils/memocache.py" in v.detail
+               for v in vs), [v.detail for v in vs]
+    # the clean shape (Name reference at the stamp site) passes
+    assert ast_lint.check_memo_schema({ast_lint.MEMOCACHE_PATH: (
+        "MEMOCACHE_SCHEMA_VERSION = 1\n"
+        "def put():\n"
+        "    return {\"schema\": MEMOCACHE_SCHEMA_VERSION}\n"
+    )}) == []
+
+
 def test_registry_loader_reads_legacy_and_schema2(tmp_path):
     legacy = tmp_path / "legacy.json"
     legacy.write_text(json.dumps({"k": "abc"}))

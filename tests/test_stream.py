@@ -74,8 +74,10 @@ def _assert_rows_match(stream_rows, static_rows):
 
 
 @pytest.fixture(scope="module")
-def sync_runner():
-    return BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync")
+def sync_runner(ring8_sync_stream_runner):
+    # the session-scoped shared instance (conftest): same (TOPO, CFG,
+    # delay, B) shape as declared above, compiled once for the whole gate
+    return ring8_sync_stream_runner
 
 
 @pytest.fixture(scope="module")
@@ -148,8 +150,8 @@ def test_checkpoint_v6_kill_and_resume_mid_queue(sync_runner, pool,
 
 
 def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
-    # the supported range in the error must have widened to v7 (the
-    # flight-recorder format): an operator holding a too-NEW file learns
+    # the supported range in the error must have widened to v8 (the
+    # memo-plane format): an operator holding a too-NEW file learns
     # both sides of the mismatch
     path = str(tmp_path / "v99.npz")
     tree = {"x": np.zeros(3, np.int32)}
@@ -158,7 +160,7 @@ def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
     monkeypatch.undo()
     with pytest.raises(CheckpointError,
                        match=r"version 99.*supported version range "
-                             r"v\d+\.\.v7"):
+                             r"v\d+\.\.v8"):
         load_state(path, tree)
 
 

@@ -51,9 +51,12 @@ def analyze_telemetry(path: str) -> None:
           f"(schema {records[0]['schema']})")
     for kind, cnt in sorted(kinds.items()):
         print(f"  {kind:<16} {cnt}")
-    # run rows: one headline per row, whatever kind produced it
+    # run rows: one headline per row, whatever kind produced it (the memo
+    # plane's hit/coalesce/fast-forward books ride along when present)
     run_keys = ("value", "unit", "trace_events", "trace_dropped",
-                "error_bits", "jobs_done", "snapshots", "wall_seconds")
+                "error_bits", "jobs_done", "snapshots", "wall_seconds",
+                "memo", "cache_hits", "coalesced_jobs", "ff_skipped_ticks",
+                "shadow_checks", "memo_hit_rate", "effective_jobs_per_sec")
     for r in records:
         if not r["kind"].endswith("_run"):
             continue
@@ -71,8 +74,16 @@ def analyze_telemetry(path: str) -> None:
     jobs = [r for r in records if r["kind"] == "stream_job"]
     if jobs:
         errored = [j for j in jobs if j.get("error")]
-        print(f"  stream jobs: {len(jobs)} harvested, "
-              f"{len(errored)} errored")
+        served = [j for j in jobs if j.get("served_from")]
+        line = (f"  stream jobs: {len(jobs)} harvested, "
+                f"{len(errored)} errored")
+        if served:
+            from_cache = sum(1 for j in served
+                             if j["served_from"] == "cache")
+            line += (f", {len(served)} memo-served "
+                     f"({from_cache} cache, "
+                     f"{len(served) - from_cache} coalesced)")
+        print(line)
 
 
 def analyze_bench_rows(path: str) -> None:

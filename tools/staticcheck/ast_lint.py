@@ -37,6 +37,16 @@ Rules (ids are stable; the README rule table documents them):
                       ``mode=``: the default ("fill_or_drop"-ish semantics
                       differing by op) hides out-of-bounds intent and costs
                       a select XLA can't always elide.
+  memo-knob           ENGINE_KNOBS declares the ``memo`` knob with exactly
+                      the off/admit/full ladder ("off" first — the neutral
+                      arm is the default), and ``resolve_memo`` validates
+                      against the table, not a restated inline spelling
+                      tuple that can drift from it.
+  memo-schema         MEMOCACHE_SCHEMA_VERSION is ONE module-level int
+                      literal in utils/memocache.py; every schema-stamping
+                      dict there references the Name (a restated literal
+                      would let the written and checked versions diverge),
+                      and no other module re-assigns the constant.
 """
 
 from __future__ import annotations
@@ -52,6 +62,11 @@ CONFIG_PATH = "chandy_lamport_tpu/config.py"
 GRAPHSHARD_PATH = "chandy_lamport_tpu/parallel/graphshard.py"
 CLI_PATH = "chandy_lamport_tpu/cli.py"
 BENCH_PATH = "chandy_lamport_tpu/bench.py"
+MEMOCACHE_PATH = "chandy_lamport_tpu/utils/memocache.py"
+
+# the memo opt-in ladder; "off" first — the table order IS the contract
+# (off is the default and the bit-identity baseline)
+MEMO_SPELLINGS = ("off", "admit", "full")
 
 # modules whose function bodies are traced into jaxprs (directly or via the
 # kernels/runners) — host clock/RNG imports are banned here
@@ -479,6 +494,150 @@ def check_scatter_mode(sources: Dict[str, str]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# memo-knob
+
+
+def check_memo_knob(sources: Dict[str, str]) -> List[Violation]:
+    """The memo knob's spellings live in ENGINE_KNOBS and nowhere else:
+    the table row must be exactly the off/admit/full ladder (off first),
+    and ``resolve_memo`` must consult the table by Name instead of
+    restating the spellings in an inline tuple/list/set that would drift
+    when a fourth memo level lands."""
+    out: List[Violation] = []
+    tree = _parse(sources, CONFIG_PATH)
+    if tree is None:
+        return out
+    memo_row: Optional[Tuple[ast.expr, int]] = None
+    for node in tree.body:
+        value = _assign_value(node)
+        if "ENGINE_KNOBS" in _assign_targets(node) and \
+                isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and k.value == "memo":
+                    memo_row = (v, k.lineno)
+    if memo_row is None:
+        return [Violation(
+            "memo-knob", CONFIG_PATH,
+            "ENGINE_KNOBS has no 'memo' row — the memoization ladder must "
+            "be declared in the knob table like every other engine knob")]
+    row_value, row_line = memo_row
+    spellings = tuple(
+        e.value for e in getattr(row_value, "elts", [])
+        if isinstance(e, ast.Constant))
+    if spellings != MEMO_SPELLINGS:
+        out.append(Violation(
+            "memo-knob", f"{CONFIG_PATH}:{row_line}",
+            f"ENGINE_KNOBS['memo'] = {spellings!r}, expected "
+            f"{MEMO_SPELLINGS!r} — 'off' leads (it is the default and the "
+            f"bit-identity baseline) and the ladder is the documented "
+            f"opt-in order"))
+
+    resolver: Optional[Tuple[str, ast.FunctionDef]] = None
+    for path, src in sources.items():
+        if not path.startswith("chandy_lamport_tpu/"):
+            continue
+        try:
+            t = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(t):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == "resolve_memo":
+                resolver = (path, node)
+    if resolver is None:
+        # knob-pattern already reports the missing resolver
+        return out
+    rpath, rnode = resolver
+    if not any(isinstance(n, ast.Name) and n.id == "ENGINE_KNOBS"
+               for n in ast.walk(rnode)):
+        out.append(Violation(
+            "memo-knob", f"{rpath}:{rnode.lineno}",
+            "resolve_memo does not consult ENGINE_KNOBS — the accepted "
+            "spellings must come from the table, not a local copy"))
+    for n in ast.walk(rnode):
+        if isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            inline = {e.value for e in n.elts
+                      if isinstance(e, ast.Constant)}
+            if {"admit", "full"} <= inline:
+                out.append(Violation(
+                    "memo-knob", f"{rpath}:{n.lineno}",
+                    f"resolve_memo restates the memo spellings inline "
+                    f"({sorted(inline)}) — validate against "
+                    f"ENGINE_KNOBS['memo'] so the ladder has one home"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# memo-schema
+
+
+def check_memo_schema(sources: Dict[str, str]) -> List[Violation]:
+    """MEMOCACHE_SCHEMA_VERSION is a single named registry constant: one
+    module-level int-literal assignment in utils/memocache.py, referenced
+    by Name from every ``"schema":``-stamping dict there (a restated
+    literal lets the written and the checked version diverge across a
+    bump), and never re-assigned an int literal in any other module."""
+    out: List[Violation] = []
+    for path, src in sorted(sources.items()):
+        if path == MEMOCACHE_PATH:
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            value = _assign_value(node)
+            if "MEMOCACHE_SCHEMA_VERSION" in _assign_targets(node) and \
+                    isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int):
+                out.append(Violation(
+                    "memo-schema", f"{path}:{node.lineno}",
+                    f"MEMOCACHE_SCHEMA_VERSION = {value.value}: the memo "
+                    f"cache schema version lives only in utils/memocache.py "
+                    f"— import it, don't shadow it"))
+
+    tree = _parse(sources, MEMOCACHE_PATH)
+    if tree is None:
+        return out + [Violation(
+            "memo-schema", MEMOCACHE_PATH,
+            "utils/memocache.py not found in lint input")]
+    decls: List[Tuple[ast.stmt, Optional[ast.expr]]] = []
+    for node in tree.body:
+        if "MEMOCACHE_SCHEMA_VERSION" in _assign_targets(node):
+            decls.append((node, _assign_value(node)))
+    if not decls:
+        out.append(Violation(
+            "memo-schema", MEMOCACHE_PATH,
+            "no module-level MEMOCACHE_SCHEMA_VERSION — the cache format "
+            "needs one named registry constant"))
+    elif len(decls) > 1:
+        out.append(Violation(
+            "memo-schema", f"{MEMOCACHE_PATH}:{decls[1][0].lineno}",
+            "MEMOCACHE_SCHEMA_VERSION assigned more than once — one "
+            "declaration, one value"))
+    else:
+        value = decls[0][1]
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, int)):
+            out.append(Violation(
+                "memo-schema", f"{MEMOCACHE_PATH}:{decls[0][0].lineno}",
+                "MEMOCACHE_SCHEMA_VERSION must be a bare int literal — a "
+                "computed version can change without a reviewable diff"))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value == "schema" and \
+                    isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.append(Violation(
+                    "memo-schema", f"{MEMOCACHE_PATH}:{v.lineno}",
+                    f"schema stamped with restated literal {v.value} — "
+                    f"reference MEMOCACHE_SCHEMA_VERSION so write and "
+                    f"check sites cannot diverge"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 ALL_RULES = (
@@ -488,6 +647,8 @@ ALL_RULES = (
     check_knob_pattern,
     check_traced_imports,
     check_scatter_mode,
+    check_memo_knob,
+    check_memo_schema,
 )
 
 

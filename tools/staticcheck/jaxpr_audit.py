@@ -204,12 +204,12 @@ def _loop_entry(name: str) -> Entry:
     raise KeyError(name)
 
 
-def _batch_runner(scheduler: str, trace=False):
+def _batch_runner(scheduler: str, trace=False, memo="off"):
     from chandy_lamport_tpu.models.workloads import ring_topology
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
     return BatchedRunner(
         ring_topology(8, tokens=16), _cfg(trace_capacity=64 if trace else 0),
-        _delay(), 2, scheduler=scheduler, megatick=2)
+        _delay(), 2, scheduler=scheduler, megatick=2, memo=memo)
 
 
 def _storm_entry(scheduler: str) -> Entry:
@@ -228,22 +228,32 @@ def _storm_entry(scheduler: str) -> Entry:
                  state_out=False)
 
 
-def _stream_entry() -> Entry:
+def _stream_entry(memo: str = "off") -> Entry:
     import jax
     import jax.numpy as jnp
     from chandy_lamport_tpu.models.workloads import stream_jobs
     from chandy_lamport_tpu.models.workloads import ring_topology
-    runner = _batch_runner("sync")
+    runner = _batch_runner("sync", memo=memo)
     jobs = stream_jobs(ring_topology(8, tokens=16), 4, seed=5,
                        base_phases=2, max_phases=4)
-    pool = runner.pack_jobs(jobs)
+    pool = runner.pack_jobs(jobs, content_keys=True if memo != "off"
+                            else None)
     stream = runner.init_stream(pool)
     state = runner.init_batch()
     pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
     step = runner._stream_step(2, 8, False)
-    return Entry(key="batch.stream.step", fn=step,
-                 args=(state, stream, pool_dev), jit_fn=step,
-                 donated=(0, 1), state_out=False)
+    if memo == "off":
+        return Entry(key="batch.stream.step", fn=step,
+                     args=(state, stream, pool_dev), jit_fn=step,
+                     donated=(0, 1), state_out=False)
+    # the memo step takes the admission indirection (execution order +
+    # follower counts) as device operands; a trivial identity plan keeps
+    # the trace small while exercising the memo="full" signature plane
+    order = jnp.arange(len(jobs), dtype=jnp.int32)
+    followers = jnp.zeros((len(jobs),), jnp.int32)
+    return Entry(key=f"batch.stream.step.memo={memo}", fn=step,
+                 args=(state, stream, pool_dev, order, followers),
+                 jit_fn=step, donated=(0, 1), state_out=False)
 
 
 def _graphshard_entry(comm_engine: str) -> Entry:
@@ -300,8 +310,9 @@ def iter_entry_builders(mode: str = "full"):
     queue_engine {gather,mask} x kernel_engine {xla,pallas} x faults x
     trace (fold skips faulted arms: the specification form refuses the
     fault engine), the sync tick over the same engine arms, the loop/
-    inject entries, both storm schedulers, the stream step, both
-    graphshard comm engines, and the Pallas kernels under interpret.
+    inject entries, both storm schedulers, the stream step (plain and
+    under memo="full", which adds the rolling state-signature plane),
+    both graphshard comm engines, and the Pallas kernels under interpret.
 
     fast — one arm per engine axis on the same tiny graphs: enough for
     tier-1 to prove the audit machinery against live traces without
@@ -352,6 +363,7 @@ def iter_entry_builders(mode: str = "full"):
         yield f"batch.storm.{scheduler}", (
             lambda s=scheduler: _storm_entry(s))
     yield "batch.stream.step", _stream_entry
+    yield "batch.stream.step.memo=full", (lambda: _stream_entry("full"))
     for comm in ("dense", "sparse"):
         yield f"graphshard.dispatch.comm={comm}", (
             lambda c=comm: _graphshard_entry(c))
