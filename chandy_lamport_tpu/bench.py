@@ -280,6 +280,26 @@ def _parser() -> argparse.ArgumentParser:
                         "plane at this level and report effective jobs/s "
                         "(served = executed + coalesced) A/B against the "
                         "memo-off arm on the same content-keyed pool")
+    p.add_argument("--serve", action="store_true",
+                   help="measure the online serving front-end "
+                        "(chandy_lamport_tpu/serving.serve_run) instead of "
+                        "the storm metric: a seeded Poisson/Zipf request "
+                        "schedule (--jobs requests at --rate per step) "
+                        "served live, reported as effective jobs/s with "
+                        "occupancy, admit p50/p99, deadline misses and the "
+                        "cold-vs-warm executable-cache warmup drop in the "
+                        "same row")
+    p.add_argument("--serve-policy", choices=["edf", "fifo"], default="edf",
+                   help="--serve: admission ordering knob "
+                        "(config.ENGINE_KNOBS serve_policy); the row also "
+                        "carries the fifo baseline at the same schedule")
+    p.add_argument("--rate", type=float, default=2.0,
+                   help="--serve: open-loop Poisson arrival rate "
+                        "(requests per stream step)")
+    p.add_argument("--tenants", type=int, default=4,
+                   help="--serve: Zipf-weighted tenant population")
+    p.add_argument("--priorities", type=int, default=2,
+                   help="--serve: priority classes")
     p.add_argument("--trace", action="store_true",
                    help="arm the device flight recorder (utils/tracing.py) "
                         "during the measurement; the row gains trace_"
@@ -489,6 +509,8 @@ def run_worker(args) -> int:
 
     if args.graphshard:
         return run_graphshard_worker(args, dev, spec, cfg)
+    if args.serve:
+        return run_serve_worker(args, dev, spec, cfg)
     if args.stream:
         return run_stream_worker(args, dev, spec, cfg)
 
@@ -933,6 +955,141 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
             + "stream-vs-gang speedup is platform-relative, not a chip "
               "throughput claim")
     _write_telemetry(args, "bench_stream", result)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def run_serve_worker(args, dev, spec, cfg) -> int:
+    """--serve: the online serving metric (chandy_lamport_tpu/serving).
+    One seeded Poisson/Zipf schedule served twice per policy arm — the
+    COLD pass pays the fresh trace+compile and persists the jax.export
+    artifact; the WARM pass simulates a restarted server (fresh runner,
+    fresh ExecutableCache over the same directory) and must load the
+    lowered program from disk. The warmup drop between the two is the
+    row's restart-skips-recompile evidence; occupancy, admit p50/p99 and
+    deadline misses come from the timed edf arm, with the fifo baseline's
+    numbers alongside."""
+    import tempfile
+    import time as _time
+
+    import jax
+
+    from chandy_lamport_tpu.models.workloads import serve_workload
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.serving import ExecutableCache, serve_run
+
+    rcount = args.jobs or 3 * args.batch
+    requests = serve_workload(spec, rcount, seed=17, rate=args.rate,
+                              tenants=args.tenants,
+                              priorities=args.priorities,
+                              dup_rate=args.dup_rate,
+                              max_phases=max(args.phases, 8))
+    log(f"serve: {rcount} requests over {args.batch} slots at rate "
+        f"{args.rate}/step, tenants={args.tenants}, "
+        f"dup_rate={args.dup_rate}, policy={args.serve_policy}")
+
+    def mk_runner():
+        return BatchedRunner(spec, cfg, make_fast_delay(args.delay, 17),
+                             batch=args.batch, scheduler=args.scheduler,
+                             exact_impl=args.exact_impl,
+                             megatick=args.megatick,
+                             queue_engine=args.queue_engine,
+                             kernel_engine=args.kernel_engine)
+
+    cache_dir = tempfile.mkdtemp(prefix="clsim-serve-exec-")
+
+    def drive(policy, exec_cache):
+        runner = mk_runner()
+        t0 = _time.perf_counter()
+        state, stream, report = serve_run(runner, requests, policy=policy,
+                                          stretch=args.stretch,
+                                          drain_chunk=args.drain_chunk,
+                                          exec_cache=exec_cache)
+        jax.block_until_ready(state)
+        wall = _time.perf_counter() - t0
+        rows = runner.stream_results(stream)
+        return wall, report, rows
+
+    # cold pass: fresh process-equivalent (empty cache dir), persists the
+    # lowered artifact; doubles as the correctness gate
+    wall_cold, rep_cold, rows = drive(args.serve_policy,
+                                      ExecutableCache(cache_dir))
+    bad = [r for r in rows if r["error"]]
+    if bad:
+        log(f"ERROR: {len(bad)} requests retired with error bits "
+            f"(first: {bad[0]}) — results invalid")
+        return 1
+    if len(rows) != rcount - rep_cold["refused_total"]:
+        log("ERROR: serve drive did not serve every accepted request")
+        return 1
+    log(f"cold: warmup {rep_cold['warmup_s']:.1f}s "
+        f"({rep_cold['warmup_source']}, persisted="
+        f"{rep_cold['warmup_persisted']}), serve wall "
+        f"{rep_cold['wall_s']:.2f}s")
+
+    # warm pass: a RESTARTED server — new runner, new ExecutableCache over
+    # the same directory; the memory plane is empty, so a 'disk' warmup
+    # source proves the artifact round-trip
+    best = None
+    rep_warm = None
+    for r in range(args.repeats):
+        wall, rep, _ = drive(args.serve_policy, ExecutableCache(cache_dir))
+        served_s = rep["served_total"] / rep["wall_s"] if rep["wall_s"] \
+            else 0.0
+        log(f"warm run {r}: warmup {rep['warmup_s']:.1f}s "
+            f"({rep['warmup_source']}), {served_s:.1f} effective jobs/s")
+        if best is None or rep["wall_s"] < best:
+            best, rep_warm = rep["wall_s"], rep
+    # fifo baseline at the same schedule (warm cache; one run)
+    _, rep_fifo, _ = drive("fifo", ExecutableCache(cache_dir))
+    mem = _memory_stats(dev)
+
+    result = {
+        "metric": "serve_effective_jobs_per_sec",
+        "value": round(rep_warm["served_total"] / rep_warm["wall_s"], 2)
+        if rep_warm["wall_s"] else 0.0,
+        "unit": "jobs/s",
+        "serve_policy": args.serve_policy,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "scheduler": (args.scheduler if args.scheduler == "sync"
+                      else f"exact/{args.exact_impl}"),
+        "graph": args.graph, "nodes": args.nodes, "batch": args.batch,
+        "requests": rcount, "rate": args.rate, "tenants": args.tenants,
+        "dup_rate": args.dup_rate, "repeats": args.repeats,
+        "stretch": args.stretch, "drain_chunk": args.drain_chunk,
+        "occupancy": rep_warm["occupancy"],
+        "admit_p50": rep_warm["admit_p50"],
+        "admit_p99": rep_warm["admit_p99"],
+        "deadline_misses": rep_warm["deadline_misses"],
+        "memo_hit_rate": rep_warm["memo_hit_rate"],
+        "served_total": rep_warm["served_total"],
+        "refused_total": rep_warm["refused_total"],
+        "steps": rep_warm["steps"],
+        # the restart-skips-recompile evidence: cold pays the fresh
+        # trace+compile, warm deserializes the persisted StableHLO
+        "warmup_cold_s": rep_cold["warmup_s"],
+        "warmup_warm_s": rep_warm["warmup_s"],
+        "warmup_warm_source": rep_warm["warmup_source"],
+        "warmup_drop": round(
+            1.0 - rep_warm["warmup_s"] / rep_cold["warmup_s"], 3)
+        if rep_cold["warmup_s"] else 0.0,
+        # the fifo baseline's service quality at the identical schedule
+        "deadline_misses_fifo": rep_fifo["deadline_misses"],
+        "admit_p99_fifo": rep_fifo["admit_p99"],
+        "occupancy_fifo": rep_fifo["occupancy"],
+    }
+    result.update(mem)
+    if dev.platform != "tpu":
+        deliberate = (os.environ.get("CLSIM_PLATFORM") == "cpu"
+                      and "CLSIM_FALLBACK" not in os.environ)
+        result["note"] = (
+            ("deliberate CPU run; " if deliberate
+             else "non-TPU fallback (device tunnel down?); ")
+            + "serving throughput is platform-relative, not a chip "
+              "throughput claim")
+    _write_telemetry(args, "bench_serve", result)
     print(json.dumps(result), flush=True)
     return 0
 

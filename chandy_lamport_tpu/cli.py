@@ -17,6 +17,12 @@ this covers the same ground and the scale workflows the reference lacks:
   stream continuous lane scheduling: drive a queue of J heterogeneous jobs
          through B lane slots, refilling each slot the moment its job
          retires (parallel/batch.run_stream); prints jobs/s + occupancy
+  serve  online multi-tenant serving (chandy_lamport_tpu/serving): a
+         seeded Poisson/Zipf open-loop request schedule admitted live
+         under the serve_policy knob (EDF within priority class / fifo)
+         with per-tenant quotas, ingest-time memo serving, per-interval
+         telemetry JSONL and a persistent executable cache that lets a
+         restarted server skip the cold compile
   bench  the node-ticks/sec benchmark (same engine as /bench.py)
 
 Usage: python -m chandy_lamport_tpu <command> [args]
@@ -421,6 +427,111 @@ def _cmd_stream(args) -> int:
     return 0 if (faults is not None or not errored) else 1
 
 
+def _cmd_serve(args) -> int:
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi,
+        ring_topology,
+        scale_free,
+        serve_workload,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.serving import ExecutableCache, serve_run
+    from chandy_lamport_tpu.utils.checkpoint import load_state
+
+    if args.checkpoint_every and not args.checkpoint:
+        print("--checkpoint-every needs --checkpoint PATH (the file the "
+              "periodic (state, stream) snapshots land in)", file=sys.stderr)
+        return 2
+    tokens = args.max_phases + 10
+    gen = {"ring": lambda: ring_topology(args.nodes, tokens=tokens),
+           "er": lambda: erdos_renyi(args.nodes, 3.0, args.seed,
+                                     tokens=tokens),
+           "sf": lambda: scale_free(args.nodes, 2, args.seed,
+                                    tokens=tokens)}[args.graph]
+    spec = gen()
+    cfg = SimConfig.for_workload(snapshots=args.snapshots,
+                                 split_markers=args.scheduler == "sync")
+    runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
+                           batch=args.batch, scheduler=args.scheduler,
+                           kernel_engine=args.kernel_engine,
+                           memo_cache=args.memo_cache,
+                           memo_cache_entries=args.memo_cache_entries,
+                           memo_cache_bytes=args.memo_cache_bytes)
+    rcount = args.requests or 3 * args.batch
+    quotas = ([int(x) for x in args.quota.split(",")] if args.quota
+              else None)
+    reqs = serve_workload(spec, rcount, seed=args.seed, rate=args.rate,
+                          tenants=args.tenants, priorities=args.priorities,
+                          deadline_slack=tuple(args.deadline_slack),
+                          dup_rate=args.dup_rate,
+                          base_phases=args.base_phases,
+                          tail_alpha=args.tail_alpha,
+                          max_phases=args.max_phases)
+    state = stream = None
+    if args.resume_from:
+        # same-flags `like` template (shape/treedef validation rejects a
+        # checkpoint from a different queue, tenant or batch shape); the
+        # serving books (deadline misses, per-tenant counts) ride the
+        # carry, so the resumed accounting is bit-exact
+        tenants = max(args.tenants, len(quotas) if quotas else 0)
+        pool = runner.pack_jobs([r.events for r in reqs],
+                                content_keys=True)
+        like = (runner.init_batch(),
+                runner.init_stream(
+                    pool, args.results_capacity, tenants=tenants,
+                    tenant_quota=(list(quotas)
+                                  + [0] * (tenants - len(quotas))
+                                  if quotas else None)))
+        (state, stream), meta = load_state(args.resume_from, like)
+        print(f"resumed from {args.resume_from} at {meta}", file=sys.stderr)
+    telemetry = None
+    if args.telemetry:
+        from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+        telemetry = TelemetryWriter(args.telemetry)
+    try:
+        state, stream, report = serve_run(
+            runner, reqs, policy=args.serve_policy, quotas=quotas,
+            stretch=args.stretch, drain_chunk=args.drain_chunk,
+            results_capacity=args.results_capacity, state=state,
+            stream=stream, checkpoint=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            kill_after_saves=args.kill_after_saves,
+            telemetry=telemetry,
+            telemetry_interval=args.telemetry_interval,
+            exec_cache=(ExecutableCache(args.exec_cache)
+                        if args.exec_cache else None))
+        if report["killed"]:
+            # deterministic mid-queue "preemption" for the resume tests:
+            # die right after that many checkpoints landed
+            print(json.dumps({"killed_after_steps": report["steps"],
+                              "checkpoint": args.checkpoint}))
+            return 17
+        rows = runner.stream_results(stream)
+        if telemetry is not None:
+            for r in rows:
+                telemetry.write("serve_job", r)
+    finally:
+        if telemetry is not None:
+            telemetry.close()
+    row = runner.summarize_stream(stream)
+    row.update(report)
+    row.update({"graph": args.graph, "nodes": runner.topo.n,
+                "batch": args.batch, "rate": args.rate,
+                "dup_rate": args.dup_rate, "scheduler": args.scheduler,
+                "serve_policy": args.serve_policy})
+    errored = [r for r in rows if r["error"]]
+    row["jobs_errored"] = len(errored)
+    if errored:
+        row["job_errors"] = {r["job"]: r["errors_decoded"]
+                             for r in errored[:16]}
+    if args.telemetry:
+        row["telemetry"] = args.telemetry
+    print(json.dumps(row))
+    return 0 if not errored else 1
+
+
 def _cmd_bench(args) -> int:
     from chandy_lamport_tpu.bench import main as bench_main
 
@@ -684,6 +795,91 @@ def main(argv=None) -> int:
                     help="append a stream_run row plus one stream_job row "
                          "per harvested job as schema-versioned JSONL")
     pq.set_defaults(fn=_cmd_stream)
+
+    pz = sub.add_parser("serve", help="online multi-tenant serving over "
+                                      "the stream engine "
+                                      "(chandy_lamport_tpu/serving)")
+    pz.add_argument("--graph", choices=["ring", "er", "sf"], default="sf")
+    pz.add_argument("--nodes", type=int, default=256)
+    pz.add_argument("--batch", type=int, default=64,
+                    help="lane slots B (device batch width)")
+    pz.add_argument("--requests", type=int, default=0,
+                    help="request count J (0 = 3x batch)")
+    pz.add_argument("--rate", type=float, default=2.0,
+                    help="open-loop Poisson arrival rate in requests per "
+                         "stream step (models/workloads.serve_workload)")
+    pz.add_argument("--tenants", type=int, default=4,
+                    help="Zipf-weighted tenant population")
+    pz.add_argument("--priorities", type=int, default=2,
+                    help="priority classes (higher class admits first "
+                         "under edf)")
+    pz.add_argument("--deadline-slack", type=int, nargs=2,
+                    default=[64, 256], metavar=("LO", "HI"),
+                    help="per-request deadline = arrival + uniform[LO, HI] "
+                         "stream steps; misses are counted in the carry")
+    pz.add_argument("--quota", metavar="N,N,...",
+                    help="per-tenant admission caps, comma-separated in "
+                         "tenant order (0 = unlimited); requests over "
+                         "quota are refused at ingest, never starving "
+                         "other tenants")
+    pz.add_argument("--serve-policy", choices=["edf", "fifo"],
+                    default="edf",
+                    help="admission ordering (config.ENGINE_KNOBS): 'edf' "
+                         "= earliest deadline first within priority "
+                         "class; 'fifo' = arrival order (the baseline)")
+    pz.add_argument("--dup-rate", type=float, default=0.0, metavar="R",
+                    help="fraction of requests repeating a Zipf-drawn "
+                         "scenario-library job byte-for-byte — served "
+                         "from the memo plane without burning a lane")
+    pz.add_argument("--base-phases", type=int, default=4)
+    pz.add_argument("--tail-alpha", type=float, default=1.1)
+    pz.add_argument("--max-phases", type=int, default=32)
+    pz.add_argument("--snapshots", type=int, default=8)
+    pz.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
+    pz.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],
+                    default="auto")
+    pz.add_argument("--seed", type=int, default=0)
+    pz.add_argument("--delay", choices=["uniform", "hash"], default="hash")
+    pz.add_argument("--stretch", type=int, default=4)
+    pz.add_argument("--drain-chunk", type=int, default=32)
+    pz.add_argument("--results-capacity", type=int, default=0,
+                    help="results-ring slots (0 = one per request; must "
+                         "cover every executed job)")
+    pz.add_argument("--memo-cache", metavar="PATH",
+                    help="persistent content-addressed summary cache — "
+                         "warm digests are served at INGEST, without a "
+                         "lane (utils/memocache.py)")
+    pz.add_argument("--memo-cache-entries", type=int, default=0,
+                    help="summary-cache LRU capacity in entries (0 = "
+                         "unbounded)")
+    pz.add_argument("--memo-cache-bytes", type=int, default=0,
+                    help="summary-cache LRU capacity in serialized bytes "
+                         "(0 = unbounded)")
+    pz.add_argument("--exec-cache", metavar="DIR",
+                    help="shape-bucketed executable cache directory "
+                         "(serving/executables.py): jax.export artifacts "
+                         "let a restarted server skip the cold compile at "
+                         "a seen shape bucket")
+    pz.add_argument("--checkpoint", help="save the combined (state, stream) "
+                                         "carry to this .npz")
+    pz.add_argument("--checkpoint-every", type=int, default=0, metavar="K",
+                    help="checkpoint every K stream steps; a killed server "
+                         "resumes via --resume-from bit-exactly (admission "
+                         "is a memoryless function of the saved carry)")
+    pz.add_argument("--resume-from", metavar="PATH",
+                    help="resume a killed serve run (pass the SAME flags)")
+    pz.add_argument("--kill-after-saves", type=int, default=None,
+                    help=argparse.SUPPRESS)  # resume-test hook: exit 17
+    #                                          after that many checkpoints
+    pz.add_argument("--telemetry", metavar="PATH",
+                    help="schema-versioned JSONL: one serve_interval row "
+                         "per --telemetry-interval steps (occupancy, "
+                         "admit p50/p99, deadline misses, memo hits, "
+                         "per-tenant books), a final serve_run row and "
+                         "one serve_job row per served request")
+    pz.add_argument("--telemetry-interval", type=int, default=64,
+                    metavar="K")
+    pz.set_defaults(fn=_cmd_serve)
 
     pb = sub.add_parser(
         "bench", help="node-ticks/sec benchmark",

@@ -42,6 +42,12 @@ ENGINE_KNOBS = {
     # there is no backend-dependent resolution, only an explicit
     # opt-in ladder.
     "memo": ("off", "admit", "full"),
+    # serving admission policy (serving/admission.resolve_serve_policy):
+    # "edf" (default) orders the eligible queue by priority class then
+    # earliest deadline first; "fifo" is the arrival-order baseline the
+    # serve bench A/Bs against. No backend resolution — pure validation,
+    # like "memo".
+    "serve_policy": ("edf", "fifo"),
 }
 
 

@@ -226,6 +226,9 @@ CHECKPOINT_FORMAT_HISTORY: Tuple[Tuple[int, str], ...] = (
         "(cache_hits/coalesced_jobs/ff_skipped_ticks/shadow_checks): a "
         "kill mid-stream resumes the fast-forward memo and hit "
         "accounting bit-exactly"),
+    (9, "serving-plane StreamState leaves (deadline_misses + per-tenant "
+        "tenant_served/tenant_quota books): a killed serve run resumes "
+        "its deadline-miss and fairness accounting bit-exactly"),
 )
 CHECKPOINT_FORMAT_VERSION = CHECKPOINT_FORMAT_HISTORY[-1][0]
 

@@ -151,6 +151,71 @@ def stream_jobs(spec: TopologySpec, count: int, seed: int,
     return jobs
 
 
+class ServeRequest(NamedTuple):
+    """One job of an open-loop serving workload (serving/server.py): the
+    event-list payload plus the service metadata the admission policy
+    orders by. ``arrival_step``/``deadline_step`` are absolute stream-step
+    clocks (the serve loop's arrival gauge), ``priority`` is
+    higher-wins."""
+
+    job: int            # index into the packed pool (== list position)
+    arrival_step: int   # stream step the job becomes visible to admission
+    tenant: int         # tenant id in [0, tenants)
+    priority: int       # admission class, higher admitted first
+    deadline_step: int  # absolute harvest-by step (arrival + slack)
+    events: List[Event]
+
+
+def serve_workload(spec: TopologySpec, count: int, seed: int,
+                   rate: float = 1.0, tenants: int = 4,
+                   priorities: int = 2,
+                   deadline_slack: Tuple[int, int] = (64, 256),
+                   dup_rate: float = 0.0, base_phases: int = 4,
+                   tail_alpha: float = 1.1, max_phases: int = 64,
+                   amount: int = 1, snapshots_per_job: int = 1,
+                   ) -> List[ServeRequest]:
+    """A seeded Poisson/Zipf open-loop serving trace: ``count`` jobs whose
+    scripts are the ``stream_jobs`` heavy-tailed mix (``dup_rate``
+    controls the Zipf duplicate share the memo plane serves for free),
+    arriving at Poisson times — exponential inter-arrivals of mean
+    ``1/rate`` jobs per stream step, accumulated and floored onto the
+    integer step clock, so arrivals are independent of service (open
+    loop). Tenants are assigned Zipf-style (weight 1/(t+1): tenant 0 is
+    the heaviest, the multi-tenant fairness stress), priorities uniformly
+    over ``priorities`` classes, and each job's absolute deadline is its
+    arrival plus a uniform slack from ``deadline_slack``. Deterministic
+    in ``seed``: two calls with equal arguments produce byte-identical
+    traces (the serve kill->resume path replans from this property).
+    Returned in arrival order (ties keep job order)."""
+    if count < 1:
+        raise ValueError("count must be >= 1")
+    if rate <= 0.0:
+        raise ValueError("rate must be > 0 (jobs per stream step)")
+    if tenants < 1 or priorities < 1:
+        raise ValueError("tenants and priorities must be >= 1")
+    lo, hi = int(deadline_slack[0]), int(deadline_slack[1])
+    if not 0 < lo <= hi:
+        raise ValueError("deadline_slack must be 0 < lo <= hi")
+    jobs = stream_jobs(spec, count, seed, base_phases=base_phases,
+                       tail_alpha=tail_alpha, max_phases=max_phases,
+                       amount=amount, snapshots_per_job=snapshots_per_job,
+                       dup_rate=dup_rate)
+    rng = random.Random(seed + 0x5E12E)
+    tweights = [1.0 / (t + 1) for t in range(tenants)]
+    clock = 0.0
+    reqs: List[ServeRequest] = []
+    for j, ev in enumerate(jobs):
+        clock += rng.expovariate(rate)
+        arrival = int(clock)
+        reqs.append(ServeRequest(
+            job=j, arrival_step=arrival,
+            tenant=rng.choices(range(tenants), weights=tweights)[0],
+            priority=rng.randrange(priorities),
+            deadline_step=arrival + rng.randint(lo, hi),
+            events=ev))
+    return reqs
+
+
 class StormProgram(NamedTuple):
     """Compiled storm traffic: T phases, each = bulk sends + snapshot
     initiations + one tick."""

@@ -57,6 +57,8 @@ from chandy_lamport_tpu.utils.tracing import (
     EV_LANE_COALESCE,
     EV_LANE_HARVEST,
     EV_MEMO_HIT,
+    EV_SERVE_ADMIT,
+    EV_SERVE_MISS,
     JaxTrace,
     trace_append_lanes,
     trace_counts,
@@ -311,6 +313,14 @@ class StreamState(NamedTuple):
     coalesced_jobs: Any    # i32 []  duplicate jobs served by a rep lane
     ff_skipped_ticks: Any  # i32 []  ticks credited by fast-forward
     shadow_checks: Any     # i32 []  served summaries re-proven by shadow
+    # serving-plane books (checkpoint format v9): deadline_misses and
+    # tenant_served accumulate on-device at harvest in the serve step
+    # (serving/server.py); tenant_quota is the admission cap the server
+    # was configured with, carried so a resumed run re-derives the same
+    # refusal decisions. Plain stream runs carry T=1 zeros.
+    deadline_misses: Any   # i32 []  jobs harvested past their deadline
+    tenant_served: Any     # i32 [T] jobs harvested per tenant
+    tenant_quota: Any      # i32 [T] admission cap per tenant (0 = none)
     res_count: Any         # i32 []  results written (ring wraps past R)
     res_job: Any            # i32 [R]    job id (-1 = empty slot)
     res_time: Any           # i32 [R]    final lane clock
@@ -340,7 +350,8 @@ class BatchedRunner:
                  queue_engine: str = "auto",
                  kernel_engine: Optional[str] = None, faults=None,
                  quarantine: bool = False, trace=None,
-                 memo: str = "off", memo_cache: Optional[str] = None):
+                 memo: str = "off", memo_cache: Optional[str] = None,
+                 memo_cache_entries: int = 0, memo_cache_bytes: int = 0):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -434,11 +445,19 @@ class BatchedRunner:
         (MEMO_SHADOW_EVERY). ``memo_cache``: path of the persistent
         JSON-lines summary cache (memocache.SummaryCache; None keeps the
         cache in-memory per run, so only coalescing and fast-forwarding
-        apply across one call)."""
+        apply across one call). ``memo_cache_entries``/
+        ``memo_cache_bytes``: LRU capacity bounds for that cache
+        (SummaryCache docstring; 0 = unbounded)."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.memo = resolve_memo(memo)
         self.memo_cache_path = memo_cache
+        self.memo_cache_entries = int(memo_cache_entries)
+        self.memo_cache_bytes = int(memo_cache_bytes)
+        # eviction books of the most recent run's cache (the capacity-
+        # bounded LRU satellite): summarize_stream surfaces them
+        self._memo_cache_stats = {"cache_evictions": 0,
+                                  "cache_evicted_bytes": 0}
         # per-run rows served without execution (job -> result row);
         # stream_results merges them with the harvested ring
         self._memo_rows: dict = {}
@@ -509,6 +528,7 @@ class BatchedRunner:
         self._auto_broken = self._auto_unavailable
         self._storm_aot = {}   # (drain, prog shapes) -> (compiled, relayout)
         self._storm_prog_placed = {}  # same key -> (host values, placed prog)
+        self._storm_rejected = set()  # keys whose AOT call was rejected
         self._storm_state_formats = None
         self._run = jax.jit(
             jax.vmap(self._run_single, in_axes=(0, None)), donate_argnums=0)
@@ -550,7 +570,15 @@ class BatchedRunner:
             return "default"
         if self._auto_unavailable:
             return "default(auto-unavailable)"
-        return "default(auto-rejected)" if self._auto_broken else "auto"
+        if self._auto_broken:
+            return "default(auto-rejected)"
+        if self._storm_rejected:
+            # per-key degradation: only the rejecting shape bucket fell
+            # back; other compiled buckets stay warm on the AOT path
+            if not self._storm_aot:
+                return "default(auto-rejected)"
+            return f"auto(+{len(self._storm_rejected)} rejected)"
+        return "auto"
 
     def storm_state_formats(self):
         """The compiled storm program's state input Formats (layout +
@@ -640,6 +668,9 @@ class BatchedRunner:
         if not self.auto_layouts or self._auto_broken:
             return None
         prog = tuple(jnp.asarray(x) for x in program)
+        key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
+        if key in self._storm_rejected:
+            return None
         abstract_state = jax.eval_shape(self._state_builder())
         comp, _ = self._storm_compiled(abstract_state, prog, drain)
         return input_formats(comp)[0][0]
@@ -795,7 +826,9 @@ class BatchedRunner:
         Under ``auto_layouts``, dispatches the AOT-compiled executable with
         XLA-chosen boundary layouts (constructor docstring)."""
         prog = tuple(jnp.asarray(x) for x in program)
-        if not self.auto_layouts or self._auto_broken:
+        key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
+        if (not self.auto_layouts or self._auto_broken
+                or key in self._storm_rejected):
             fn = self._run_storm if drain else self._run_storm_no_drain
             return fn(state, prog)
         comp, relayout = self._storm_compiled(state, prog, drain)
@@ -804,7 +837,6 @@ class BatchedRunner:
         # chose a non-default program layout that would force the relayout
         # dispatch into every timed region. Reuse the placed copy by value
         # (the tensors are tiny; the state is the thing we must not copy).
-        key = (drain, tuple((tuple(x.shape), str(x.dtype)) for x in prog))
         cached = self._storm_prog_placed.get(key)
         if cached is not None and all(
                 np.array_equal(a, np.asarray(b))
@@ -831,19 +863,25 @@ class BatchedRunner:
         except ValueError as exc:
             if "layouts" not in str(exc):
                 raise
-            # still rejected: degrade permanently to the row-major jit
-            # boundaries (the measured round-3 path) rather than fail the
-            # run. The rejection fires before execution, so the donated
-            # buffers are still alive.
+            # still rejected: degrade THIS shape bucket permanently to the
+            # row-major jit boundaries (the measured round-3 path) rather
+            # than fail the run — other compiled buckets stay warm on the
+            # AOT path (a serving process must not re-pay every tenant's
+            # compile because one odd topology's layouts were refused).
+            # The rejection fires before execution, so the donated buffers
+            # are still alive.
             import warnings
 
             warnings.warn(
                 "auto-layout AOT call rejected executable-produced "
-                f"layouts; falling back to default boundary layouts: {exc}")
-            self._auto_broken = True
-            self._storm_state_formats = None
-            self._storm_aot.clear()  # dead executables; free their programs
-            self._storm_prog_placed.clear()
+                f"layouts; falling back to default boundary layouts for "
+                f"this program shape: {exc}")
+            self._storm_rejected.add(key)
+            self._storm_aot.pop(key, None)  # dead executable; free its prog
+            self._storm_prog_placed.pop(key, None)
+            if not self._storm_aot:
+                # no live bucket left to vouch for the formats feedback
+                self._storm_state_formats = None
             fn = self._run_storm if drain else self._run_storm_no_drain
             return fn(state, prog)
 
@@ -1028,13 +1066,24 @@ class BatchedRunner:
         return out
 
     def init_stream(self, pool: JobPool,
-                    results_capacity: Optional[int] = None) -> StreamState:
+                    results_capacity: Optional[int] = None,
+                    tenants: int = 1,
+                    tenant_quota=None) -> StreamState:
         """Fresh stream carry for ``pool``: zero counters + an empty results
         ring of ``results_capacity`` slots (default: one per job, so
-        nothing is ever evicted; smaller rings wrap, keeping the newest)."""
+        nothing is ever evicted; smaller rings wrap, keeping the newest).
+        ``tenants``/``tenant_quota`` size the serving-plane books (v9
+        leaves) — plain stream runs keep the default single zero row."""
         r = int(results_capacity) if results_capacity else pool.num_jobs
         if r < 1:
             raise ValueError("results_capacity must be >= 1")
+        t = max(1, int(tenants))
+        quota = (np.zeros(t, np.int32) if tenant_quota is None
+                 else np.asarray(tenant_quota, np.int32))
+        if quota.shape != (t,):
+            raise ValueError(
+                f"tenant_quota must be one cap per tenant ([{t}]), "
+                f"got shape {quota.shape}")
         i = np.int32
 
         def z(*sh):
@@ -1044,16 +1093,19 @@ class BatchedRunner:
             next_job=i(0), jobs_done=i(0), steps=i(0), refills=i(0),
             lane_steps_live=i(0), lane_steps_total=i(0),
             cache_hits=i(0), coalesced_jobs=i(0), ff_skipped_ticks=i(0),
-            shadow_checks=i(0), res_count=i(0),
+            shadow_checks=i(0), deadline_misses=i(0),
+            tenant_served=z(t), tenant_quota=quota, res_count=i(0),
             res_job=np.full(r, -1, np.int32), res_time=z(r), res_error=z(r),
             res_snap_started=z(r), res_snap_completed=z(r),
             res_snap_failed=z(r), res_fault_skew=z(r), res_fault_events=z(r),
             res_admit_step=z(r), res_tokens=z(r, self.topo.n))
 
-    def _stream_step(self, stretch: int, drain_chunk: int, gang: bool):
+    def _stream_step(self, stretch: int, drain_chunk: int, gang: bool,
+                     serve: bool = False):
         if not hasattr(self, "_stream_jits"):
             self._stream_jits = {}
-        key = (int(stretch), int(drain_chunk), bool(gang), self.memo)
+        key = (int(stretch), int(drain_chunk), bool(gang),
+               "off" if serve else self.memo, bool(serve))
         fn = self._stream_jits.get(key)
         if fn is None:
             fn = jax.jit(self._build_stream_step(*key),
@@ -1062,7 +1114,7 @@ class BatchedRunner:
         return fn
 
     def _build_stream_step(self, stretch: int, drain_chunk: int, gang: bool,
-                           memo: str = "off"):
+                           memo: str = "off", serve: bool = False):
         """One jitted streaming step: harvest retired lanes -> admit queued
         jobs into the freed slots -> advance every lane through the
         per-lane stage machine. The stage machine replays run()'s exact
@@ -1154,7 +1206,9 @@ class BatchedRunner:
                 s = s._replace(sig=_lane_signature(s))
             return s
 
-        def step(state, stream, pool, order=None, followers=None):
+        def step(state, stream, pool, order=None, followers=None,
+                 limit=None, tenant_of=None, arrival_of=None,
+                 deadline_of=None):
             jcount = pool.job_start.shape[0]
             jmax = jcount - 1
             rcap = stream.res_job.shape[0]
@@ -1194,6 +1248,25 @@ class BatchedRunner:
                 res_tokens=put(stream.res_tokens, h["tokens"]),
                 res_count=stream.res_count + nfin,
                 jobs_done=stream.jobs_done + nfin)
+            if serve:
+                # serving-plane books (v9): a lane harvested at a stream
+                # step past its job's absolute deadline is a miss; tenant
+                # service counts scatter-add with the OOB-drop idiom so
+                # idle lanes charge nothing
+                jc = jnp.clip(jid, 0, jmax)
+                tcap = stream.tenant_served.shape[0]
+                late = stream.steps - deadline_of[jc]
+                missed = fin & (late > 0)
+                t_of = jnp.clip(tenant_of[jc], 0, tcap - 1)
+                stream = stream._replace(
+                    deadline_misses=stream.deadline_misses
+                    + jnp.sum(missed, dtype=jnp.int32),
+                    tenant_served=stream.tenant_served.at[
+                        jnp.where(fin, t_of, tcap)].add(1, mode="drop"))
+                if self._trace_on:
+                    state = trace_append_lanes(
+                        state, missed, EV_SERVE_MISS,
+                        jnp.maximum(late, 0))
             # -- admit: reset freed slots, copy in per-job identities ------
             idle_lane = fin | ~has_job
             arank = jnp.cumsum(idle_lane.astype(jnp.int32)) - 1
@@ -1201,7 +1274,22 @@ class BatchedRunner:
             # executable: refill only when every lane is idle, so whole
             # cohorts run and retire together (bench's fair comparison)
             gate = jnp.all(idle_lane) if gang else jnp.bool_(True)
-            if memo == "off":
+            if serve:
+                # serving admission: like the memoized arm, next_job walks
+                # a host-maintained EXEC ORDER — but only up to ``limit``,
+                # the dynamic count of positions the server has marked
+                # admissible this step (arrived + quota-eligible, sorted by
+                # the admission policy). The bound is a traced scalar, so
+                # re-sorting the un-admitted suffix or extending the
+                # admissible prefix never retraces.
+                uexec = order.shape[0]
+                avail = jnp.maximum(
+                    jnp.minimum(jnp.asarray(limit, jnp.int32), uexec)
+                    - stream.next_job, 0)
+                admit = idle_lane & (arank < avail) & gate
+                epos = jnp.clip(stream.next_job + arank, 0, uexec - 1)
+                new_jid = jnp.where(admit, order[epos], -1)
+            elif memo == "off":
                 avail = jcount - stream.next_job
                 admit = idle_lane & (arank < avail) & gate
                 new_jid = stream.next_job + arank
@@ -1249,6 +1337,13 @@ class BatchedRunner:
             if self._trace_on:
                 state = trace_append_lanes(state, admit, EV_LANE_ADMIT,
                                            new_jid)
+            if self._trace_on and serve:
+                # admit latency in stream steps (arrival -> admission),
+                # stamped device-side so the flight recorder carries the
+                # serving queue's wait distribution
+                state = trace_append_lanes(
+                    state, admit, EV_SERVE_ADMIT,
+                    jnp.maximum(stream.steps - arrival_of[new_jidc], 0))
             if self._trace_on and memo != "off":
                 fcnt = followers[epos]
                 state = trace_append_lanes(state, admit & (fcnt > 0),
@@ -1353,6 +1448,13 @@ class BatchedRunner:
                                             jnp.asarray(skips))
         return state, stream
 
+    def _summary_cache(self) -> SummaryCache:
+        """The runner's persistent summary cache, opened with its LRU
+        capacity bounds (constructor knobs; 0 = unbounded)."""
+        return SummaryCache(self.memo_cache_path,
+                            max_entries=self.memo_cache_entries,
+                            max_bytes=self.memo_cache_bytes)
+
     def _memo_plan(self, pool: JobPool, shadow_every: Optional[int]) -> dict:
         """Host-side admission plan for a memoized run: classify every
         pool job by digest into leader (executes on a lane), coalesced
@@ -1372,7 +1474,7 @@ class BatchedRunner:
                 "memo != 'off' needs a content-addressed pool — pack_jobs "
                 "on a memo-enabled runner (or content_keys=True) stamps "
                 "the job digests")
-        cache = SummaryCache(self.memo_cache_path)
+        cache = self._summary_cache()
         se = MEMO_SHADOW_EVERY if shadow_every is None else int(shadow_every)
         leader: dict = {}       # digest -> ("exec", job) | ("cache", summary)
         exec_jobs: List[int] = []   # pool indices in admission order
@@ -1459,6 +1561,8 @@ class BatchedRunner:
             row["served_from"] = src
             self._memo_rows[j] = row
         cache.flush()
+        self._memo_cache_stats = {"cache_evictions": cache.evictions,
+                                  "cache_evicted_bytes": cache.evicted_bytes}
         ncache = sum(1 for it in plan["served"] if it[1] == "cache")
         ncoal = sum(1 for it in plan["served"] if it[1] == "coalesce")
         stream = stream._replace(cache_hits=np.int32(ncache),
@@ -1596,6 +1700,9 @@ class BatchedRunner:
         rcap = int(np.shape(host.res_job)[0])
         d["results_capacity"] = rcap
         d["results_evicted"] = max(0, int(host.res_count) - rcap)
+        # LRU eviction books of the most recent memoized run's cache
+        d.update(getattr(self, "_memo_cache_stats", None)
+                 or {"cache_evictions": 0, "cache_evicted_bytes": 0})
         return d
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch
@@ -1663,4 +1770,8 @@ class BatchedRunner:
             out["memo"] = {k: sc[k] for k in (
                 "cache_hits", "coalesced_jobs", "ff_skipped_ticks",
                 "shadow_checks", "memo_hit_rate")}
+            # serving-plane books (v9 leaves): the per-tenant fairness/
+            # quota accounting and deadline misses ride along the same way
+            out["serve"] = {k: sc[k] for k in (
+                "deadline_misses", "tenant_served", "tenant_quota")}
         return out

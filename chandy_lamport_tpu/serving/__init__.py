@@ -1,0 +1,45 @@
+"""clsim-serve: the online serving front-end over the stream engine.
+
+Layers (each its own module, host-side unless noted):
+
+  admission    policy knob resolution (``serve_policy`` in
+               config.ENGINE_KNOBS), the EDF/fifo queue ordering, and
+               the deterministic ingest plan (quota refusal, memo
+               cache hits, duplicate coalescing — all decided in
+               arrival order, never against device timing).
+  executables  the shape-bucketed serve-step executable cache: memory,
+               then jax.export artifacts on disk, then a fresh
+               trace+compile — a restarted server skips the cold
+               compile at any seen shape bucket.
+  server       ``serve_run``: the double-buffered host loop driving
+               BatchedRunner's serving-mode stream step (the device
+               half lives in parallel/batch.py behind ``serve=True``).
+
+``SERVE_SCHEMA_VERSION`` stamps every serve telemetry record
+(``serve_schema`` key) and checkpoint meta; bump it when the serve
+row shape changes (tools/staticcheck's AST plane enforces that it
+stays a single named constant).
+"""
+
+from chandy_lamport_tpu.serving.admission import (
+    admission_key,
+    order_eligible,
+    plan_ingest,
+    resolve_serve_policy,
+)
+from chandy_lamport_tpu.serving.executables import (
+    EXEC_CACHE_SCHEMA_VERSION,
+    ExecutableCache,
+)
+from chandy_lamport_tpu.serving.server import SERVE_SCHEMA_VERSION, serve_run
+
+__all__ = [
+    "EXEC_CACHE_SCHEMA_VERSION",
+    "ExecutableCache",
+    "SERVE_SCHEMA_VERSION",
+    "admission_key",
+    "order_eligible",
+    "plan_ingest",
+    "resolve_serve_policy",
+    "serve_run",
+]

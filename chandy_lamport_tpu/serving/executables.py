@@ -1,0 +1,208 @@
+"""Shape-bucketed serve-step executable cache.
+
+The serve step's compile is the dominant cold-start cost of a server
+process (BENCH_r02 measured 136s of warmup at sf-256), and the traced
+program is a pure function of a small identity: the runner's resolved
+execution identity (the same ingredient list as the memo plane's
+job_digest, minus per-job content), the step shape parameters
+(stretch/drain_chunk) and the abstract shapes/dtypes of every operand —
+batch width, pool phase-table height, results-ring capacity, tenant
+count, exec-order length. That identity is the BUCKET: two serve runs
+in the same bucket can share one executable.
+
+Two planes, consulted in order:
+
+  memory  — a per-process dict of AOT-compiled executables; a second
+            serve run in the same process at a seen bucket skips
+            compilation outright.
+  disk    — ``jax.export`` artifacts (serialized StableHLO) under the
+            cache directory, one file per bucket digest; a RESTARTED
+            server deserializes the lowered program and only pays XLA's
+            backend compile, skipping the trace+lower half of warmup.
+            NOTE: this deliberately persists the *lowered* program, not
+            the backend-compiled executable — compiled-executable
+            deserialization is unsound across processes on this jaxlib
+            (see tests/conftest.py) while the StableHLO artifact is a
+            stable, versioned format.
+  fresh   — trace + lower + compile from the runner, then best-effort
+            export to disk for the next process.
+
+Every ``step_for`` records what happened (bucket, source, warmup
+seconds, persistence outcome) in ``self.last`` so the server can put
+the measured warmup in its telemetry — the acceptance evidence for the
+restart-skips-recompile claim.
+
+All disk failures (unreadable artifact, refused export, version skew)
+degrade to the fresh path — the cache can never make a serve run fail,
+only make it warm up faster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import asdict
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax import export as jax_export
+
+from chandy_lamport_tpu.utils.memocache import _canon
+
+EXEC_CACHE_SCHEMA_VERSION = 1
+
+_registered = False
+
+
+def _register_serialization() -> None:
+    """jax.export refuses pytrees with unregistered custom node types;
+    the serve-step operands carry the engine's NamedTuples. Registration
+    is global and once-per-process; the serialized names are stable
+    spellings a future process must reuse to deserialize."""
+    global _registered
+    if _registered:
+        return
+    from chandy_lamport_tpu.core.state import DenseState
+    from chandy_lamport_tpu.parallel.batch import (
+        JobPool,
+        ScriptOps,
+        StreamState,
+    )
+    for cls in (DenseState, StreamState, JobPool, ScriptOps):
+        try:
+            jax_export.register_namedtuple_serialization(
+                cls, serialized_name=f"clsim.{cls.__name__}")
+        except ValueError:
+            pass  # a previous cache instance already registered it
+    _registered = True
+
+
+def _abstract(tree):
+    """ShapeDtypeStructs mirroring a pytree of concrete arrays (None
+    subtrees pass through untouched — tree_map never sees them)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        tree)
+
+
+class ExecutableCache:
+    """See module docstring. ``path`` is a DIRECTORY (created lazily);
+    ``path=None`` keeps the memory plane only."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._mem: dict = {}
+        # books of the most recent step_for: {"bucket", "source",
+        # "warmup_s", "persisted", "disk_error"?}
+        self.last: Optional[dict] = None
+
+    # -- bucket identity -------------------------------------------------
+
+    def bucket_digest(self, runner, stretch: int, drain_chunk: int,
+                      abstract_args) -> str:
+        """sha256 over everything that determines the traced serve-step
+        program: jax version (trace rules), the runner's resolved
+        identity (same recipe as memocache.job_digest's runner half),
+        the step shape knobs and the flattened operand avals."""
+        cfg = asdict(runner.config)
+        avals = [(str(a.dtype), list(a.shape)) if a is not None else None
+                 for a in jax.tree_util.tree_leaves(
+                     abstract_args, is_leaf=lambda v: v is None)]
+        payload = {
+            "schema": EXEC_CACHE_SCHEMA_VERSION,
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+            "nodes": _canon(sorted((str(k), int(v))
+                                   for k, v in runner._topo_spec.nodes)),
+            "links": _canon(sorted((str(s), str(d))
+                                   for s, d in runner._topo_spec.links)),
+            "scheduler": str(runner.scheduler),
+            "knobs": _canon({
+                "queue_engine": runner.queue_engine,
+                "kernel_engine": runner.kernel_engine,
+                "exact_impl": runner.kernel.exact_impl,
+                "megatick": runner.megatick,
+                "check_every": runner.check_every,
+                "quarantine": runner.quarantine,
+                "delay_kind": type(runner.delay).__name__,
+                "faults": (None if runner.faults is None
+                           else sorted(vars(runner.faults).items())),
+            }),
+            "config": _canon(cfg),
+            "batch": int(runner.batch),
+            "stretch": int(stretch),
+            "drain_chunk": int(drain_chunk),
+            "avals": avals,
+        }
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def _artifact_path(self, key: str) -> Optional[str]:
+        if not self.path:
+            return None
+        return os.path.join(self.path, f"serve-step-{key}.jaxexport")
+
+    # -- the cache lookup ------------------------------------------------
+
+    def step_for(self, runner, stretch: int, drain_chunk: int,
+                 example_args):
+        """The AOT-compiled serve step for this bucket, ready to call
+        with operands shaped like ``example_args``. Compilation (or
+        deserialization) happens eagerly HERE so the caller's warmup
+        timing brackets the true cost; ``self.last`` records the books."""
+        abstract = _abstract(example_args)
+        key = self.bucket_digest(runner, stretch, drain_chunk, abstract)
+        t0 = time.perf_counter()
+        call = self._mem.get(key)
+        if call is not None:
+            self.last = {"bucket": key, "source": "memory",
+                         "warmup_s": time.perf_counter() - t0,
+                         "persisted": False}
+            return call
+        source, disk_error, persisted = "fresh", None, False
+        apath = self._artifact_path(key)
+        if apath and os.path.exists(apath):
+            try:
+                _register_serialization()
+                with open(apath, "rb") as f:
+                    exported = jax_export.deserialize(bytearray(f.read()))
+                fn = jax.jit(exported.call, donate_argnums=(0, 1))
+                call = fn.lower(*abstract).compile()
+                source = "disk"
+            except Exception as exc:  # degrade, never fail the run
+                call, disk_error = None, f"{type(exc).__name__}: {exc}"
+        if call is None:
+            fn = jax.jit(
+                runner._build_stream_step(stretch, drain_chunk, False,
+                                          "off", True),
+                donate_argnums=(0, 1))
+            call = fn.lower(*abstract).compile()
+            if apath:
+                persisted, disk_error = self._persist(apath, fn, abstract)
+        self._mem[key] = call
+        self.last = {"bucket": key, "source": source,
+                     "warmup_s": time.perf_counter() - t0,
+                     "persisted": persisted}
+        if disk_error:
+            self.last["disk_error"] = disk_error
+        return call
+
+    @staticmethod
+    def _persist(apath: str, fn, abstract) -> tuple:
+        """Best-effort export of the lowered program, written atomically
+        (tmp + rename) so a killed server never leaves a torn artifact."""
+        try:
+            _register_serialization()
+            exported = jax_export.export(fn)(*abstract)
+            blob = exported.serialize()
+            os.makedirs(os.path.dirname(apath) or ".", exist_ok=True)
+            tmp = apath + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, apath)
+            return True, None
+        except Exception as exc:
+            return False, f"{type(exc).__name__}: {exc}"

@@ -1,0 +1,360 @@
+"""The serve loop: an online, multi-tenant front-end over the stream step.
+
+``serve_run`` drives a timed open-loop request schedule
+(models/workloads.serve_workload) through BatchedRunner's serving-mode
+stream step. The division of labor with run_stream's batch loop:
+
+* The DEVICE runs the identical harvest -> admit -> advance step, plus
+  the serving-plane books (deadline misses, per-tenant service counts —
+  v9 StreamState leaves). Admission walks a host-maintained exec-order
+  array up to a dynamic ``limit`` scalar, so the device program never
+  retraces as the queue reorders.
+
+* The HOST owns time-aware admission: each iteration it re-sorts the
+  arrived-but-unadmitted requests under the ``serve_policy`` knob (EDF
+  within priority class, or fifo), rewrites the un-admitted suffix of
+  the exec order, and raises ``limit`` to the admissible prefix length.
+  Ingestion is double-buffered against the device: the step for host
+  time S is dispatched asynchronously, the arrivals for S+1 are packed
+  while it runs, and only then does the host touch the step's output
+  scalars (the one sync point per iteration).
+
+Memo digests are taken at INGEST (admission.plan_ingest): a request
+whose digest is warm in the persistent SummaryCache is served its
+summary the moment it arrives, without ever burning a lane; duplicate
+requests coalesce onto the first accepted leader and are fanned out at
+finalize exactly like run_stream's memo plane. Quota refusal happens at
+ingest too, against the deterministic arrival order — never against the
+device's drain speed.
+
+Kill -> resume is bit-exact because every host decision is a memoryless
+function of state the resumed process can reconstruct: the ingest plan
+is pure in (requests, cache file, quotas); the pending set is "arrived
+and not admitted", where the admitted set is recoverable from the saved
+carry (results ring + in-flight lane job ids); and the eligible
+ordering is re-sorted from scratch each step. Positions of the exec
+order below ``next_job`` are never re-read by the device, so their
+content need not survive the crash. Admit-latency percentiles are
+process-local observability (they reset on resume); every RESULT row
+and every carried counter is identical to the uninterrupted run.
+
+Compilation warmup goes through serving.executables.ExecutableCache —
+a restarted server at a seen shape bucket deserializes the lowered
+program from disk instead of re-tracing (``warmup_source`` in the
+report/telemetry records which plane served it).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chandy_lamport_tpu.models.workloads import ServeRequest
+from chandy_lamport_tpu.parallel.batch import JobPool, _ring_rows
+from chandy_lamport_tpu.serving.admission import (
+    order_eligible,
+    plan_ingest,
+    resolve_serve_policy,
+)
+from chandy_lamport_tpu.serving.executables import ExecutableCache
+
+SERVE_SCHEMA_VERSION = 1
+
+
+def _percentiles(lat: Sequence[int]) -> dict:
+    if not lat:
+        return {"admit_p50": None, "admit_p99": None, "admit_max": None}
+    a = np.asarray(lat)
+    return {"admit_p50": float(np.percentile(a, 50)),
+            "admit_p99": float(np.percentile(a, 99)),
+            "admit_max": int(a.max())}
+
+
+def serve_run(runner, requests: List[ServeRequest], *,
+              policy: str = "edf",
+              quotas: Optional[Sequence[int]] = None,
+              stretch: int = 4, drain_chunk: int = 32,
+              results_capacity: Optional[int] = None,
+              state=None, stream=None,
+              max_steps: int = 1_000_000,
+              checkpoint: Optional[str] = None,
+              checkpoint_every: int = 0,
+              kill_after_saves: Optional[int] = None,
+              telemetry=None, telemetry_interval: int = 64,
+              exec_cache: Optional[ExecutableCache] = None):
+    """Serve a timed request schedule; returns ``(state, stream, report)``.
+
+    ``requests`` must be serve_workload-style: ``job`` equal to list
+    index, arrivals non-decreasing. ``quotas``: per-tenant admission
+    caps (0/absent = unlimited), enforced at ingest. Checkpointing and
+    ``kill_after_saves`` mirror run_stream (a killed run returns early
+    with ``report["killed"] = True``; resume by passing the loaded
+    ``state=``/``stream=`` back with the SAME requests/quotas/policy).
+    ``telemetry``: a utils.tracing.TelemetryWriter — one
+    ``serve_interval`` row per ``telemetry_interval`` steps and a final
+    ``serve_run`` row, each stamped with SERVE_SCHEMA_VERSION. Results
+    come from ``runner.stream_results(stream)`` as usual; refused
+    requests get no row (the report carries per-tenant refusal counts).
+    """
+    from chandy_lamport_tpu.utils.checkpoint import save_state
+
+    policy = resolve_serve_policy(policy)
+    if stretch < 1 or drain_chunk < 1:
+        raise ValueError("stretch and drain_chunk must be >= 1")
+    total = len(requests)
+    for i, r in enumerate(requests):
+        if r.job != i:
+            raise ValueError("requests must be indexed by arrival order "
+                             f"(request {i} has job id {r.job})")
+        if i and r.arrival_step < requests[i - 1].arrival_step:
+            raise ValueError("request arrivals must be non-decreasing")
+    tenants = max([r.tenant for r in requests] or [0]) + 1
+    if quotas is not None:
+        tenants = max(tenants, len(quotas))
+    quota_arr = np.zeros(tenants, np.int32)
+    if quotas is not None:
+        quota_arr[:len(quotas)] = np.asarray(quotas, np.int32)
+
+    # ingest plan: pure in (requests, cache file, quotas) — see module
+    # docstring for why that purity is the resume story
+    pool = runner.pack_jobs([r.events for r in requests],
+                            content_keys=True)
+    digests = [bytes(bytearray(np.asarray(pool.digest[j], np.uint8)
+                               .tolist())).hex()
+               for j in range(pool.num_jobs)]
+    cache = runner._summary_cache()
+    plan = plan_ingest(requests, digests, cache, quota_arr.tolist())
+    n_exec = len(plan["exec"])
+    rcap = int(results_capacity) if results_capacity else pool.num_jobs
+    if rcap < n_exec:
+        raise ValueError(
+            f"serve needs results_capacity >= executed jobs ({n_exec}): "
+            f"followers are fanned out from leaders' ring rows and resume "
+            f"reconstructs the admitted set from the ring")
+
+    if state is None:
+        state = runner.init_batch()
+    resuming = stream is not None
+    if stream is None:
+        stream = runner.init_stream(pool, rcap, tenants=tenants,
+                                    tenant_quota=quota_arr)
+    runner._memo_rows = {}
+
+    arrival_host = np.asarray([r.arrival_step for r in requests], np.int32)
+    tenant_dev = jnp.asarray([r.tenant for r in requests], np.int32)
+    arrival_dev = jnp.asarray(arrival_host)
+    deadline_dev = jnp.asarray([r.deadline_step for r in requests],
+                               np.int32)
+    pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
+    exec_order = np.full(max(n_exec, 1), -1, np.int32)
+
+    # -- host books ------------------------------------------------------
+    admitted: set = set()
+    pending: set = set()
+    arr_ptr = 0
+    books = {"cache_served": 0, "refused_seen": 0}
+    admit_all: List[int] = []
+    admit_window: List[int] = []
+
+    def ingest_upto(step_bound: int) -> None:
+        """Admit arrivals with arrival_step <= step_bound into the host
+        books; cache hits are served on the spot, followers wait for
+        their leader's harvest (finalize), refused requests are only
+        counted."""
+        nonlocal arr_ptr
+        while (arr_ptr < total
+               and requests[arr_ptr].arrival_step <= step_bound):
+            r = requests[arr_ptr]
+            arr_ptr += 1
+            st = plan["status"][r.job]
+            if st == "exec":
+                if r.job not in admitted:
+                    pending.add(r.job)
+            elif st == "cache":
+                row = dict(plan["cache_hit"][r.job])
+                row.update(job=r.job, admit_step=-1,
+                           digest=digests[r.job], served_from="cache")
+                runner._memo_rows[r.job] = row
+                books["cache_served"] += 1
+            elif st == "refused":
+                books["refused_seen"] += 1
+            # followers: nothing to do until their leader harvests
+
+    consumed, steps_now, done_exec = (
+        (int(x) for x in jax.device_get(
+            (stream.next_job, stream.steps, stream.jobs_done)))
+        if resuming else (0, 0, 0))
+    if resuming:
+        # reconstruct the admitted set from the carry: every admission
+        # landed either in the results ring or on a still-running lane
+        host = jax.device_get((stream.res_job, stream.res_count,
+                               state.job_id))
+        ring_jobs, res_count, lane_jobs = host
+        admitted = {int(j) for j in
+                    np.asarray(ring_jobs)[:min(int(res_count),
+                                               len(ring_jobs))]
+                    if int(j) >= 0}
+        admitted |= {int(j) for j in np.asarray(lane_jobs) if int(j) >= 0}
+        if len(admitted) != consumed:
+            raise ValueError(
+                f"resume carry inconsistent: next_job={consumed} but "
+                f"{len(admitted)} admitted jobs reconstructed — was the "
+                f"checkpoint taken with the same requests and capacity?")
+        # order content below next_job is never re-read by the device;
+        # any fixed deterministic fill keeps the array well-formed
+        exec_order[:consumed] = np.asarray(sorted(admitted), np.int32)
+    ingest_upto(steps_now)
+
+    # -- executable warmup (serving.executables) -------------------------
+    warm = {"warmup_s": 0.0, "source": None, "persisted": False}
+    call = None
+    if n_exec and done_exec < n_exec:
+        exec_cache = exec_cache or ExecutableCache(None)
+        call = exec_cache.step_for(
+            runner, stretch, drain_chunk,
+            (state, stream, pool_dev, jnp.asarray(exec_order), None,
+             np.int32(0), tenant_dev, arrival_dev, deadline_dev))
+        warm = {"warmup_s": round(exec_cache.last["warmup_s"], 3),
+                "source": exec_cache.last["source"],
+                "persisted": exec_cache.last["persisted"]}
+
+    def telemetry_row(kind: str, extra: dict) -> None:
+        if telemetry is None:
+            return
+        host = jax.device_get((stream.deadline_misses,
+                               stream.tenant_served,
+                               stream.lane_steps_live,
+                               stream.lane_steps_total))
+        miss, served_t, live, lane_total = host
+        row = {"serve_schema": SERVE_SCHEMA_VERSION, "step": steps_now,
+               "arrived": arr_ptr, "admitted": consumed,
+               "harvested": done_exec, "pending": len(pending),
+               "occupancy": round(int(live) / max(int(lane_total), 1), 4),
+               "deadline_misses": int(miss),
+               "memo_hits": books["cache_served"],
+               # share of the requests seen so far that the warm summary
+               # cache served at ingest (coalesce service only counts in
+               # the final report — followers are materialized at
+               # finalize, after their leader's harvest)
+               "memo_hit_rate": round(
+                   books["cache_served"] / max(arr_ptr, 1), 4),
+               "refused": books["refused_seen"],
+               "tenant_served": np.asarray(served_t).astype(int).tolist(),
+               "tenant_quota": quota_arr.astype(int).tolist()}
+        row.update(extra)
+        telemetry.write(kind, row)
+
+    # -- the device loop -------------------------------------------------
+    saves = 0
+    t_loop = time.perf_counter()
+    while done_exec < n_exec:
+        if steps_now >= max_steps:
+            raise RuntimeError(
+                f"serve_run: {n_exec - done_exec} of {n_exec} executed "
+                f"jobs unfinished after {max_steps} steps — raise "
+                f"max_steps")
+        elig = order_eligible([requests[j] for j in sorted(pending)],
+                              policy)
+        exec_order[consumed:consumed + len(elig)] = \
+            np.asarray([r.job for r in elig], np.int32)
+        limit = consumed + len(elig)
+        # dispatch is async; the arrivals for the NEXT host time are
+        # ingested while the device steps (double buffering), and only
+        # the scalar read below synchronizes
+        state, stream = call(state, stream, pool_dev,
+                             jnp.asarray(exec_order), None,
+                             np.int32(limit), tenant_dev, arrival_dev,
+                             deadline_dev)
+        ingest_upto(steps_now + 1)
+        prev = consumed
+        consumed, steps_now, done_exec = (int(x) for x in jax.device_get(
+            (stream.next_job, stream.steps, stream.jobs_done)))
+        for pos in range(prev, consumed):
+            j = int(exec_order[pos])
+            admitted.add(j)
+            pending.discard(j)
+            lat = (steps_now - 1) - int(arrival_host[j])
+            admit_all.append(lat)
+            admit_window.append(lat)
+        if (telemetry is not None and telemetry_interval
+                and steps_now % int(telemetry_interval) == 0):
+            telemetry_row("serve_interval", _percentiles(admit_window))
+            admit_window = []
+        if (checkpoint and checkpoint_every
+                and steps_now % int(checkpoint_every) == 0):
+            save_state(checkpoint, (state, stream),
+                       meta={"stream_steps": steps_now,
+                             "jobs_done": done_exec,
+                             "serve_schema": SERVE_SCHEMA_VERSION})
+            saves += 1
+            if kill_after_saves is not None \
+                    and saves >= int(kill_after_saves):
+                return state, stream, {
+                    "serve_schema": SERVE_SCHEMA_VERSION, "killed": True,
+                    "steps": steps_now, "saves": saves, **warm}
+    wall_s = time.perf_counter() - t_loop
+
+    # tail arrivals past the last harvest never need the device: the
+    # plan guarantees they are cache hits, followers or refusals
+    ingest_upto(np.iinfo(np.int32).max)
+
+    # -- finalize: write-back, follower fan-out, books -------------------
+    ring = {r["job"]: r for r in _ring_rows(stream)}
+
+    def summary_of(row):
+        return {k: v for k, v in row.items()
+                if k not in ("job", "admit_step")}
+
+    for e in plan["exec"]:
+        r = ring.get(e)
+        if r is not None:
+            cache.put(digests[e], summary_of(r))
+    ncoal = 0
+    for leader, fls in plan["followers"].items():
+        r = ring.get(leader)
+        if r is None or not fls:
+            continue
+        summ = summary_of(r)
+        for j in fls:
+            row = dict(summ)
+            row.update(job=j, admit_step=-1, digest=digests[j],
+                       served_from="coalesce")
+            runner._memo_rows[j] = row
+            ncoal += 1
+    cache.flush()
+    runner._memo_cache_stats = {"cache_evictions": cache.evictions,
+                                "cache_evicted_bytes": cache.evicted_bytes}
+    stream = stream._replace(
+        cache_hits=np.int32(books["cache_served"]),
+        coalesced_jobs=np.int32(ncoal))
+
+    host = jax.device_get((stream.deadline_misses, stream.tenant_served,
+                           stream.lane_steps_live,
+                           stream.lane_steps_total))
+    miss, served_t, live, lane_total = host
+    nserved = n_exec + books["cache_served"] + ncoal
+    report = {
+        "serve_schema": SERVE_SCHEMA_VERSION, "killed": False,
+        "policy": policy, "requests": total, "tenants": tenants,
+        "steps": steps_now, "exec_jobs": n_exec,
+        "served_cache": books["cache_served"], "served_coalesced": ncoal,
+        "served_total": nserved, "refused_total": books["refused_seen"],
+        "refused_by_tenant": {str(t): int(c)
+                              for t, c in sorted(plan["refused"].items())},
+        "occupancy": round(int(live) / max(int(lane_total), 1), 4),
+        "deadline_misses": int(miss),
+        "memo_hit_rate": round(
+            (books["cache_served"] + ncoal) / max(nserved, 1), 4),
+        "tenant_served": np.asarray(served_t).astype(int).tolist(),
+        "tenant_quota": quota_arr.astype(int).tolist(),
+        "wall_s": round(wall_s, 3), **_percentiles(admit_all),
+        "warmup_s": warm["warmup_s"], "warmup_source": warm["source"],
+        "warmup_persisted": warm["persisted"],
+    }
+    if telemetry is not None:
+        telemetry.write("serve_run", dict(report))
+    return state, stream, report

@@ -36,6 +36,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -130,14 +131,59 @@ class SummaryCache:
     ``flush`` is atomic. An entry's summary is the per-job result row as
     plain JSON scalars/lists (parallel/batch.stream_results row minus
     the job index, which is pool-relative, plus the producer's digest so
-    telemetry can prove provenance)."""
+    telemetry can prove provenance).
 
-    def __init__(self, path: Optional[str]):
+    **Capacity bounds** (``max_entries``/``max_bytes``, 0 = unbounded):
+    the store is an LRU — ``get`` hits and ``put`` inserts refresh
+    recency; crossing either cap evicts the least-recently-used entries
+    (counted in ``evictions``/``evicted_bytes``, surfaced through the
+    memo books in ``summarize_stream``). The byte charge per entry is
+    its serialized cache-line length, so ``max_bytes`` bounds the FILE
+    the flush writes. ``flush`` persists in recency order, meaning
+    recency survives restarts: a reloaded cache evicts the same entries
+    a long-lived one would have."""
+
+    def __init__(self, path: Optional[str], max_entries: int = 0,
+                 max_bytes: int = 0):
+        if max_entries < 0 or max_bytes < 0:
+            raise ValueError("cache capacity bounds must be >= 0")
         self.path = path
-        self._entries: Dict[str, dict] = {}
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._nbytes: Dict[str, int] = {}
+        self._total_bytes = 0
         self._dirty = False
         if path is not None and os.path.exists(path):
             self._load(path)
+            self._evict()
+
+    @staticmethod
+    def _line_bytes(digest: str, summary: dict) -> int:
+        return len(json.dumps(
+            {"schema": MEMOCACHE_SCHEMA_VERSION, "digest": digest,
+             "summary": summary}, sort_keys=True)) + 1
+
+    def _charge(self, digest: str, summary: dict) -> None:
+        self._total_bytes -= self._nbytes.get(digest, 0)
+        nb = self._line_bytes(digest, summary)
+        self._nbytes[digest] = nb
+        self._total_bytes += nb
+
+    def _evict(self) -> None:
+        while self._entries and (
+                (self.max_entries
+                 and len(self._entries) > self.max_entries)
+                or (self.max_bytes
+                    and self._total_bytes > self.max_bytes)):
+            digest, _ = self._entries.popitem(last=False)
+            nb = self._nbytes.pop(digest)
+            self._total_bytes -= nb
+            self.evictions += 1
+            self.evicted_bytes += nb
+            self._dirty = True
 
     def _load(self, path: str) -> None:
         try:
@@ -178,7 +224,11 @@ class SummaryCache:
                 raise MemoCacheError(
                     f"memo cache {path}: line {lineno} summary is not an "
                     f"object")
+            # file order is recency order (flush writes LRU-first), so a
+            # straight insert reconstructs the recency chain
             self._entries[digest] = entry["summary"]
+            self._entries.move_to_end(digest)
+            self._charge(digest, entry["summary"])
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -187,11 +237,17 @@ class SummaryCache:
         return digest in self._entries
 
     def get(self, digest: str) -> Optional[dict]:
-        return self._entries.get(digest)
+        hit = self._entries.get(digest)
+        if hit is not None:
+            self._entries.move_to_end(digest)
+        return hit
 
     def put(self, digest: str, summary: dict) -> None:
         self._entries[digest] = summary
+        self._entries.move_to_end(digest)
+        self._charge(digest, summary)
         self._dirty = True
+        self._evict()
 
     def flush(self) -> None:
         """Atomically persist every entry (tmp-then-``os.replace``,

@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 import jax.numpy as jnp
+import numpy as np
 
 from chandy_lamport_tpu.config import SimConfig
 from chandy_lamport_tpu.core.state import DenseState
@@ -184,6 +185,14 @@ def stream_counters(stream) -> Dict[str, Any]:
         "ff_skipped_ticks": int(stream.ff_skipped_ticks),
         "shadow_checks": int(stream.shadow_checks),
         "memo_hit_rate": round((hits + coalesced) / served, 4) if served else 0.0,
+        # serving plane (serving/server.py over the v9 leaves): jobs
+        # harvested past their absolute deadline, and the per-tenant
+        # service/quota books the serve step maintains at harvest
+        "deadline_misses": int(stream.deadline_misses),
+        "tenant_served": np.asarray(stream.tenant_served)
+        .astype(int).tolist(),
+        "tenant_quota": np.asarray(stream.tenant_quota)
+        .astype(int).tolist(),
     }
 
 

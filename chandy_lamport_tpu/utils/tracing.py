@@ -111,14 +111,16 @@ EV_LANE_ADMIT = 10   # payload = job id                actor = 0
 EV_LANE_HARVEST = 11  # payload = job id               actor = 0
 EV_LANE_COALESCE = 12  # payload = follower count      actor = 0
 EV_MEMO_HIT = 13     # payload = ticks fast-forwarded  actor = 0
+EV_SERVE_ADMIT = 14  # payload = admit wait (steps)    actor = 0
+EV_SERVE_MISS = 15   # payload = lateness (steps)      actor = 0
 
 EVENT_KIND_NAMES = (
     "send", "recv", "marker-send", "marker-recv", "snapshot-start",
     "snapshot-end", "supervisor-abort", "supervisor-retry",
     "supervisor-fail", "fault", "lane-admit", "lane-harvest",
-    "lane-coalesce", "memo-hit")
+    "lane-coalesce", "memo-hit", "serve-admit", "serve-miss")
 
-_KIND_BITS = 5          # 14 kinds defined, headroom to 31
+_KIND_BITS = 5          # 16 kinds defined, headroom to 31
 _KIND_MASK = (1 << _KIND_BITS) - 1
 
 
@@ -336,6 +338,10 @@ def _event_line(ev: TraceRecord, topo) -> str:
         return f"\tlane: coalesce({ev.payload} followers)"
     if ev.kind == EV_MEMO_HIT:
         return f"\tlane: memo-hit(fast-forwarded {ev.payload} ticks)"
+    if ev.kind == EV_SERVE_ADMIT:
+        return f"\tlane: serve-admit(waited {ev.payload} steps)"
+    if ev.kind == EV_SERVE_MISS:
+        return f"\tlane: serve-miss({ev.payload} steps late)"
     return f"\t?: {ev.kind_name}({ev.payload})"
 
 

@@ -71,3 +71,31 @@ def ring8_sync_stream_runner():
         ring_topology(8), SimConfig.for_workload(snapshots=4,
                                                  max_recorded=128),
         make_fast_delay("hash", 11), 4, scheduler="sync")
+
+
+@pytest.fixture(scope="session")
+def batched8_default_ref():
+    """The auto-layouts battery's shared reference arm: ONE default-layout
+    (row-major) runner on the 8nodes golden topology plus its phases-6
+    storm run, compiled and executed once for the whole session. Every
+    test in the battery needs these same reference bits to prove the
+    auto_layouts mechanism changes layouts, never values — each used to
+    rebuild the runner and re-pay the ~4 s storm compile. Returns
+    ``(ref_runner, prog, ref_final)`` with ``ref_final`` on the host.
+    Tests must not mutate the runner (the auto=True arms under test
+    build their own); running other programs through it is fine — that
+    is the point, its jit caches accumulate on the instance."""
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import storm_program
+    from chandy_lamport_tpu.ops.delay_jax import UniformJaxDelay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.fixtures import read_topology_file
+    from chandy_lamport_tpu.utils.goldens import fixture_path
+
+    topo_spec = read_topology_file(fixture_path("8nodes.top"))
+    runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
+                           batch=4, scheduler="sync", auto_layouts=False)
+    prog = storm_program(runner.topo, phases=6, amount=1,
+                         snapshot_phases=[(0, 0), (2, 4)])
+    final = jax.device_get(runner.run_storm(runner.init_batch_device(), prog))
+    return runner, prog, final

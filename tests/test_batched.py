@@ -118,55 +118,42 @@ def test_independent_streams_conserve_tokens_per_lane():
     assert lanes_diverged  # streams actually differ across lanes
 
 
-def test_auto_layouts_matches_default():
+def test_auto_layouts_matches_default(batched8_default_ref):
     """The bench's --layouts auto path (XLA-chosen jit-boundary layouts,
     VERDICT r4 #6): a storm run under auto_layouts + the state_formats ->
     init_batch_device(formats=...) feedback must be bit-identical to the
     row-major default. Identity on CPU layouts-wise, but this pins the
     whole mechanism (AUTO jits accept jit-built states, the formats
     builder emits a consumable state, values unchanged)."""
-    from chandy_lamport_tpu.models.workloads import storm_program
+    ref_runner, prog, ref = batched8_default_ref
+    assert ref_runner.storm_state_formats() is None  # default mode: none
 
     topo_spec, _ = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
-    outs = []
-    for auto in (False, True):
-        runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
-                               batch=4, scheduler="sync", auto_layouts=auto)
-        prog = storm_program(runner.topo, phases=6, amount=1,
-                             snapshot_phases=[(0, 0), (2, 4)])
-        final = runner.run_storm(runner.init_batch_device(), prog)
-        fmts = runner.storm_state_formats()
-        assert (fmts is not None) == auto
-        # second dispatch from a formats-built fresh state (the bench's
-        # timed-repeat shape)
-        final = runner.run_storm(runner.init_batch_device(formats=fmts), prog)
-        outs.append(jax.device_get(final))
-    for leaf_d, leaf_a in zip(jax.tree_util.tree_leaves(outs[0]),
-                              jax.tree_util.tree_leaves(outs[1])):
+    runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
+                           batch=4, scheduler="sync", auto_layouts=True)
+    final = runner.run_storm(runner.init_batch_device(), prog)
+    fmts = runner.storm_state_formats()
+    assert fmts is not None
+    # second dispatch from a formats-built fresh state (the bench's
+    # timed-repeat shape)
+    final = runner.run_storm(runner.init_batch_device(formats=fmts), prog)
+    for leaf_d, leaf_a in zip(jax.tree_util.tree_leaves(ref),
+                              jax.tree_util.tree_leaves(
+                                  jax.device_get(final))):
         np.testing.assert_array_equal(np.asarray(leaf_d), np.asarray(leaf_a))
 
 
-def test_auto_layout_rejection_falls_back():
+def test_auto_layout_rejection_falls_back(batched8_default_ref):
     """If the AOT executable rejects the ``input_formats``-derived layouts
     at call time (observed on the axon TPU tunnel, where ``input_formats``
     can disagree with the executable's true parameter layouts), the runner
     must degrade permanently to the row-major jit path, produce the same
     bits, and report the degradation via ``layouts_effective``."""
-    from chandy_lamport_tpu.models.workloads import storm_program
-
     topo_spec, _ = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
+    _, prog, ref = batched8_default_ref
 
-    def make(auto):
-        r = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
-                          batch=4, scheduler="sync", auto_layouts=auto)
-        p = storm_program(r.topo, phases=6, amount=1,
-                          snapshot_phases=[(0, 0), (2, 4)])
-        return r, p
-
-    ref_runner, prog = make(False)
-    ref = jax.device_get(ref_runner.run_storm(ref_runner.init_batch_device(), prog))
-
-    runner, prog = make(True)
+    runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
+                           batch=4, scheduler="sync", auto_layouts=True)
     state = runner.init_batch_device()
     progj = tuple(jnp.asarray(x) for x in prog)
 
@@ -204,19 +191,79 @@ def test_auto_layout_rejection_falls_back():
     jax.block_until_ready(final2)
 
 
-def test_prepare_storm_births_state_in_compiled_formats():
+def test_auto_layout_rejection_is_per_shape_bucket(batched8_default_ref):
+    """A rejection evicts ONLY its own shape bucket: another program
+    shape compiled earlier keeps its AOT executable (and the state
+    formats feedback), and ``layouts_effective`` reports the partial
+    degradation instead of a blanket fallback — a serving process must
+    not re-pay every warm tenant's compile because one odd topology's
+    layouts were refused."""
+    from chandy_lamport_tpu.models.workloads import storm_program
+    from chandy_lamport_tpu.utils.layouts import array_format
+
+    topo_spec, _ = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
+    ref_runner, prog_a, _ = batched8_default_ref
+    runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
+                           batch=4, scheduler="sync", auto_layouts=True)
+    prog_b = storm_program(runner.topo, phases=4, amount=1,
+                           snapshot_phases=[(0, 0)])
+    # bucket A: a real compile on the live AOT path
+    jax.block_until_ready(
+        runner.run_storm(runner.init_batch_device(), prog_a))
+    key_a = (True, tuple((tuple(np.shape(x)), str(np.asarray(x).dtype))
+                         for x in prog_a))
+    assert key_a in runner._storm_aot and runner.layouts_effective == "auto"
+
+    # prog_b's reference bits ride the shared default runner too (a new
+    # jit-cache entry on its instance, not a mutation)
+    ref_b = jax.device_get(
+        ref_runner.run_storm(ref_runner.init_batch_device(), prog_b))
+
+    state = runner.init_batch_device()
+    progj_b = tuple(jnp.asarray(x) for x in prog_b)
+
+    class RejectingComp:
+        input_formats = (jax.tree_util.tree_map(
+            array_format, (state, progj_b)), {})
+
+        def __call__(self, *a):
+            raise ValueError(
+                "Computation was compiled for input layouts that disagree "
+                "with the layouts of arguments passed to it.")
+
+    key_b = (True, tuple((tuple(x.shape), str(x.dtype)) for x in progj_b))
+    runner._storm_aot[key_b] = (RejectingComp(), lambda s, p: (s, p))
+    with pytest.warns(UserWarning, match="falling back"):
+        final_b = runner.run_storm(state, prog_b)
+    # bucket B degraded, bucket A (and the formats feedback) survive
+    assert runner.layouts_effective == "auto(+1 rejected)"
+    assert key_a in runner._storm_aot and key_b not in runner._storm_aot
+    assert runner.storm_state_formats() is not None
+    for leaf_r, leaf_f in zip(jax.tree_util.tree_leaves(ref_b),
+                              jax.tree_util.tree_leaves(
+                                  jax.device_get(final_b))):
+        np.testing.assert_array_equal(np.asarray(leaf_r), np.asarray(leaf_f))
+    # bucket A still dispatches through its warm executable (no warning,
+    # no recompile), and B's shape stays on the row-major jits silently
+    final_a = runner.run_storm(runner.init_batch_device(), prog_a)
+    jax.block_until_ready(final_a)
+    assert runner.layouts_effective == "auto(+1 rejected)"
+    final_b2 = runner.run_storm(runner.init_batch_device(), prog_b)
+    jax.block_until_ready(final_b2)
+    assert key_b not in runner._storm_aot
+
+
+def test_prepare_storm_births_state_in_compiled_formats(batched8_default_ref):
     """prepare_storm compiles from shapes alone (no live state), and a
     state built via init_batch_device(formats=prepare_storm(...)) already
     matches the executable's input formats — the bench warmup relies on
     this to never pay a relayout dispatch or transient double residency."""
-    from chandy_lamport_tpu.models.workloads import storm_program
     from chandy_lamport_tpu.parallel.batch import _formats_match
 
     topo_spec, _ = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")
+    ref_runner, prog, ref = batched8_default_ref
     runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
                            batch=4, scheduler="sync", auto_layouts=True)
-    prog = storm_program(runner.topo, phases=6, amount=1,
-                         snapshot_phases=[(0, 0), (2, 4)])
     fmts0 = runner.prepare_storm(prog)
     assert fmts0 is not None
     state = runner.init_batch_device(formats=fmts0)
@@ -224,12 +271,9 @@ def test_prepare_storm_births_state_in_compiled_formats():
     final = runner.run_storm(state, prog)
     assert runner.layouts_effective == "auto"
 
-    # bit-identity with the default-layout runner
-    ref_runner = BatchedRunner(topo_spec, SimConfig(), UniformJaxDelay(seed=3),
-                               batch=4, scheduler="sync", auto_layouts=False)
+    # bit-identity with the shared default-layout runner
     assert ref_runner.prepare_storm(prog) is None  # default mode: no-op
-    ref = ref_runner.run_storm(ref_runner.init_batch_device(), prog)
-    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref)),
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
                     jax.tree_util.tree_leaves(jax.device_get(final))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 

@@ -218,6 +218,79 @@ def test_fast_forward_skips_livelocked_drain():
     assert int(s_full.steps) < int(s_off.steps)
 
 
+def _summ(t):
+    # a minimal but realistic summary row (plain JSON scalars/lists)
+    return {"time": int(t), "error": 0, "tokens": [t, t + 1],
+            "snapshots_started": 1}
+
+
+def test_cache_lru_evicts_oldest_and_counts(tmp_path):
+    c = SummaryCache(None, max_entries=2)
+    d = ["a" * 64, "b" * 64, "c" * 64]
+    c.put(d[0], _summ(0))
+    c.put(d[1], _summ(1))
+    # a get refreshes recency: d[0] becomes most-recent, so d[1] is the
+    # LRU victim when d[2] crosses the entry cap
+    assert c.get(d[0]) is not None
+    c.put(d[2], _summ(2))
+    assert c.get(d[1]) is None
+    assert c.get(d[0]) is not None and c.get(d[2]) is not None
+    assert c.evictions == 1 and c.evicted_bytes > 0
+
+
+def test_cache_max_bytes_bounds_flushed_file(tmp_path):
+    path = str(tmp_path / "bounded.jsonl")
+    line = SummaryCache._line_bytes("a" * 64, _summ(0))
+    c = SummaryCache(path, max_bytes=2 * line + 1)
+    for i, d in enumerate(("a" * 64, "b" * 64, "c" * 64)):
+        c.put(d, _summ(0))  # equal-size lines -> capacity is exactly 2
+    assert c.evictions == 1 and c.evicted_bytes == line
+    c.flush()
+    assert os.path.getsize(path) <= 2 * line + 1
+    # recency survives the restart: the survivors are the two newest
+    c2 = SummaryCache(path, max_bytes=2 * line + 1)
+    assert c2.get("a" * 64) is None
+    assert c2.get("b" * 64) is not None and c2.get("c" * 64) is not None
+
+
+def test_cache_reload_evicts_under_tightened_bounds(tmp_path):
+    # an unbounded run's file reopened with a cap evicts at LOAD time,
+    # oldest-written first (flush persists in recency order)
+    path = str(tmp_path / "tight.jsonl")
+    c = SummaryCache(path)
+    for ch in "abcd":
+        c.put(ch * 64, _summ(ord(ch)))
+    c.flush()
+    c2 = SummaryCache(path, max_entries=2)
+    assert c2.evictions == 2
+    assert c2.get("a" * 64) is None and c2.get("b" * 64) is None
+    assert c2.get("c" * 64) is not None and c2.get("d" * 64) is not None
+
+
+def test_cache_rejects_negative_bounds(tmp_path):
+    with pytest.raises(ValueError, match=">= 0"):
+        SummaryCache(None, max_entries=-1)
+    with pytest.raises(ValueError, match=">= 0"):
+        SummaryCache(None, max_bytes=-1)
+
+
+def test_runner_surfaces_eviction_counters(tmp_path, pool, off_rows):
+    # a bounded runner reports its cache evictions through the memo books
+    cache = str(tmp_path / "tiny.jsonl")
+    r = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync",
+                      memo="admit", memo_cache=cache,
+                      memo_cache_entries=2)
+    _, stream = r.run_stream(pool, stretch=3, drain_chunk=16)
+    assert _strip(r.stream_results(stream)) == _strip(off_rows)
+    summ = r.summarize_stream(stream)
+    # NUNIQ=4 distinct digests through a 2-entry cache: at least two
+    # insertions must have pushed out an older entry
+    assert summ["cache_evictions"] >= 2
+    assert summ["cache_evicted_bytes"] > 0
+    with open(cache) as f:
+        assert len(f.readlines()) <= 2
+
+
 @pytest.mark.parametrize("poison, excerpt", [
     ("{not json", "not valid JSON"),
     ('{"digest": "ab", "summary": {}}\n', "missing the"),

@@ -1,0 +1,382 @@
+"""clsim-serve (online serving front-end): admission, caches, resume.
+
+The serving contract extends the memo plane's: every admission path —
+EDF- or fifo-ordered lane execution, warm-SummaryCache ingest service,
+duplicate coalescing, quota refusal — must leave the per-job result rows
+BIT-IDENTICAL to the same content-keyed pool on the plain stream path
+(the device tick sequence is slot- and admission-independent), and a
+serve process killed mid-stream must resume onto the byte-identical
+final carry. The host-side planners (``serve_workload``,
+``order_eligible``, ``plan_ingest``) are pure and tested directly; the
+end-to-end runs share the session runner and ONE module-scoped
+``ExecutableCache`` so the serve step compiles once for the whole file
+(the disk round-trip then re-materializes it the way a restarted server
+would). The deep quota differential re-shapes the exec order (a second
+compile) and is ``slow``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.models.workloads import (
+    ServeRequest,
+    ring_topology,
+    serve_workload,
+)
+from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.serving import (
+    SERVE_SCHEMA_VERSION,
+    ExecutableCache,
+    order_eligible,
+    plan_ingest,
+    resolve_serve_policy,
+    serve_run,
+)
+from chandy_lamport_tpu.utils.checkpoint import load_state
+from chandy_lamport_tpu.utils.memocache import SummaryCache
+from chandy_lamport_tpu.utils.tracing import (
+    TELEMETRY_SCHEMA_VERSION,
+    TelemetryWriter,
+    read_telemetry,
+)
+
+TOPO = ring_topology(8)
+CFG = SimConfig.for_workload(snapshots=4, max_recorded=128)
+J, B = 12, 4
+TENANTS = 3
+
+
+def _delay():
+    return make_fast_delay("hash", 11)
+
+
+def _strip(row):
+    """Drop the admission- and provenance-dependent keys; the rest must
+    be bit-identical across every admission path."""
+    return {k: v for k, v in row.items()
+            if k not in ("admit_step", "digest", "served_from")}
+
+
+@pytest.fixture(scope="module")
+def runner(ring8_sync_stream_runner):
+    # the session-scoped shared instance (conftest): serve mode adds its
+    # own jitted step (serve=True jit key), compiled once per session
+    return ring8_sync_stream_runner
+
+
+@pytest.fixture(scope="module")
+def requests():
+    return serve_workload(TOPO, J, seed=3, rate=0.5, tenants=TENANTS,
+                          priorities=2, deadline_slack=(64, 256),
+                          dup_rate=0.3, base_phases=3, max_phases=12)
+
+
+@pytest.fixture(scope="module")
+def exec_cache(tmp_path_factory):
+    # disk-backed from the start: the reference run below persists its
+    # lowered artifact, and the round-trip test re-loads it cold
+    return ExecutableCache(str(tmp_path_factory.mktemp("serve-exec")))
+
+
+@pytest.fixture(scope="module")
+def serve_ref(runner, requests, exec_cache):
+    """The reference EDF serve run: the one fresh serve-step compile in
+    this module (later runs hit the cache's memory plane)."""
+    state, stream, report = serve_run(runner, requests, policy="edf",
+                                      stretch=3, drain_chunk=16,
+                                      exec_cache=exec_cache)
+    return state, stream, report, runner.stream_results(stream)
+
+
+# -- host-side planners (pure, jax-free) --------------------------------
+
+
+def test_serve_workload_poisson_deterministic():
+    a = serve_workload(TOPO, J, seed=3, rate=0.5, tenants=TENANTS,
+                       priorities=2, dup_rate=0.3, max_phases=12)
+    b = serve_workload(TOPO, J, seed=3, rate=0.5, tenants=TENANTS,
+                       priorities=2, dup_rate=0.3, max_phases=12)
+    assert a == b, "seeded Poisson/Zipf trace is not deterministic"
+    c = serve_workload(TOPO, J, seed=4, rate=0.5, tenants=TENANTS,
+                       priorities=2, dup_rate=0.3, max_phases=12)
+    assert [r.arrival_step for r in a] != [r.arrival_step for r in c] \
+        or [r.events for r in a] != [r.events for r in c]
+    assert [r.job for r in a] == list(range(J))
+    arr = [r.arrival_step for r in a]
+    assert arr == sorted(arr), "requests must come back in arrival order"
+    for r in a:
+        assert 0 <= r.tenant < TENANTS and r.priority in (0, 1)
+        assert 64 <= r.deadline_step - r.arrival_step <= 256
+
+
+def test_edf_orders_priority_then_deadline():
+    def req(job, arrival, prio, deadline):
+        return ServeRequest(job=job, arrival_step=arrival, tenant=0,
+                            priority=prio, deadline_step=deadline,
+                            events=[])
+    rs = [req(0, 0, 0, 50), req(1, 2, 1, 90), req(2, 1, 1, 40),
+          req(3, 3, 0, 10), req(4, 0, 1, 40)]
+    edf = [r.job for r in order_eligible(rs, "edf")]
+    # priority class first (higher wins), then earliest deadline; the
+    # (arrival, job) tiebreak makes jobs 2 vs 4 (same class+deadline)
+    # deterministic
+    assert edf == [4, 2, 1, 3, 0]
+    fifo = [r.job for r in order_eligible(rs, "fifo")]
+    assert fifo == [0, 4, 2, 1, 3]
+    with pytest.raises(ValueError, match="serve_policy must be one of"):
+        resolve_serve_policy("sjf")
+
+
+def test_plan_ingest_quota_refuses_without_starving():
+    def req(job, tenant):
+        return ServeRequest(job=job, arrival_step=job, tenant=tenant,
+                            priority=0, deadline_step=job + 64, events=[])
+    # tenant 0 floods (5 requests, quota 2); tenant 1 is quota-free
+    rs = [req(0, 0), req(1, 1), req(2, 0), req(3, 0), req(4, 1),
+          req(5, 0), req(6, 0)]
+    digests = [("%02d" % j) * 32 for j in range(len(rs))]
+    plan = plan_ingest(rs, digests, SummaryCache(None), quotas=[2, 0])
+    # refusal at INGEST in arrival order: the first two tenant-0 arrivals
+    # win, the rest are refused; tenant 1 is never starved
+    assert plan["status"] == ["exec", "exec", "exec", "refused", "exec",
+                              "refused", "refused"]
+    assert plan["accepted"] == {0: 2, 1: 2}
+    assert plan["refused"] == {0: 3}
+
+
+def test_plan_ingest_coalesces_and_serves_warm_cache():
+    def req(job, tenant=0):
+        return ServeRequest(job=job, arrival_step=job, tenant=tenant,
+                            priority=0, deadline_step=job + 64, events=[])
+    rs = [req(j) for j in range(4)]
+    digests = ["aa" * 32, "bb" * 32, "aa" * 32, "cc" * 32]
+    warm = SummaryCache(None)
+    warm.put("cc" * 32, {"time": 7, "error": 0})
+    plan = plan_ingest(rs, digests, warm)
+    # first 'aa' leads, second coalesces; 'cc' is served at ingest
+    assert plan["status"] == ["exec", "exec", "follower", "cache"]
+    assert plan["leader_of"][2] == 0 and plan["followers"][0] == [2]
+    assert plan["cache_hit"][3]["time"] == 7
+    assert plan["exec"] == [0, 1]
+
+
+def test_serve_run_validates_inputs(runner, requests):
+    bad = [requests[0]._replace(job=5)] + list(requests[1:])
+    with pytest.raises(ValueError, match="job"):
+        serve_run(runner, bad)
+    with pytest.raises(ValueError, match="results_capacity"):
+        serve_run(runner, requests, results_capacity=2)
+
+
+def test_exec_cache_bucket_digest_sensitivity(runner, requests, exec_cache):
+    # the bucket must move with anything that changes the traced program
+    a = exec_cache.bucket_digest(runner, 3, 16, (np.int32(0),))
+    assert a == exec_cache.bucket_digest(runner, 3, 16, (np.int32(0),))
+    assert a != exec_cache.bucket_digest(runner, 4, 16, (np.int32(0),))
+    assert a != exec_cache.bucket_digest(runner, 3, 8, (np.int32(0),))
+    assert a != exec_cache.bucket_digest(
+        runner, 3, 16, (np.zeros(2, np.int32),))
+
+
+# -- end-to-end: the serving loop over the device step ------------------
+
+
+def test_serve_rows_match_solo_execution(runner, serve_ref, requests):
+    """The acceptance bit-identity: every served row — lane-executed,
+    coalesced, or (here) cold — equals the plain stream path's row for
+    the same content-keyed pool."""
+    _, _, report, rows = serve_ref
+    assert len(rows) == J and report["served_total"] == J
+    pool = runner.pack_jobs([r.events for r in requests],
+                            content_keys=True)
+    _, st = runner.run_stream(pool, stretch=3, drain_chunk=16)
+    base = {r["job"]: r for r in runner.stream_results(st)}
+    for row in rows:
+        assert _strip(row) == _strip(base[row["job"]]), row["job"]
+    # dup_rate 0.3 guarantees the coalesce fan-out path actually ran
+    assert report["served_coalesced"] > 0
+    assert any(r.get("served_from") == "coalesce" for r in rows)
+
+
+def test_serve_report_books(serve_ref):
+    _, stream, report, rows = serve_ref
+    assert report["serve_schema"] == SERVE_SCHEMA_VERSION
+    assert report["killed"] is False and report["policy"] == "edf"
+    assert report["exec_jobs"] + report["served_cache"] \
+        + report["served_coalesced"] == J
+    assert report["refused_total"] == 0
+    assert 0.0 < report["occupancy"] <= 1.0
+    assert report["admit_p50"] is not None \
+        and report["admit_p99"] >= report["admit_p50"] >= 0
+    assert report["deadline_misses"] >= 0
+    # the device tenant book counts lane-served jobs only (cache and
+    # coalesce service never burns a lane)
+    assert sum(report["tenant_served"]) == report["exec_jobs"]
+    assert int(stream.jobs_done) == report["exec_jobs"]
+    assert report["warmup_source"] == "fresh" and report["warmup_persisted"]
+    assert report["memo_hit_rate"] == round(
+        (report["served_cache"] + report["served_coalesced"]) / J, 4)
+
+
+def test_serve_fifo_same_rows_as_edf(runner, requests, exec_cache,
+                                     serve_ref):
+    # the policy only permutes admission; the per-job rows are identical
+    # (and the executable comes from the cache's memory plane — the
+    # policy is a host-side knob, not a trace input)
+    _, stream, report, ref_rows = serve_ref
+    _, st2, rep2 = serve_run(runner, requests, policy="fifo",
+                             stretch=3, drain_chunk=16,
+                             exec_cache=exec_cache)
+    assert rep2["warmup_source"] == "memory"
+    rows2 = {r["job"]: r for r in runner.stream_results(st2)}
+    for row in ref_rows:
+        assert _strip(row) == _strip(rows2[row["job"]])
+
+
+def test_serve_telemetry_rows(runner, requests, exec_cache, tmp_path):
+    path = str(tmp_path / "serve.jsonl")
+    w = TelemetryWriter(path)
+    try:
+        serve_run(runner, requests, policy="edf", stretch=3,
+                  drain_chunk=16, exec_cache=exec_cache,
+                  telemetry=w, telemetry_interval=4)
+    finally:
+        w.close()
+    rows = read_telemetry(path)
+    kinds = [r["kind"] for r in rows]
+    assert kinds.count("serve_interval") >= 1
+    assert kinds[-1] == "serve_run"
+    for r in rows:
+        assert r["schema"] == TELEMETRY_SCHEMA_VERSION
+        assert r["serve_schema"] == SERVE_SCHEMA_VERSION
+    iv = next(r for r in rows if r["kind"] == "serve_interval")
+    for key in ("step", "occupancy", "deadline_misses", "admit_p50",
+                "admit_p99", "memo_hit_rate", "tenant_served"):
+        assert key in iv, key
+
+
+def test_serve_kill_resume_bit_exact(runner, requests, exec_cache,
+                                     serve_ref, tmp_path):
+    """A serve process killed mid-stream resumes onto the byte-identical
+    final carry: rows AND every StreamState leaf (counters, books, the
+    results ring) match the uninterrupted reference run."""
+    _, ref_stream, _, ref_rows = serve_ref
+    ck = str(tmp_path / "serve-ck.npz")
+    _, _, repA = serve_run(runner, requests, policy="edf", stretch=3,
+                           drain_chunk=16, exec_cache=exec_cache,
+                           checkpoint=ck, checkpoint_every=3,
+                           kill_after_saves=1)
+    assert repA["killed"] and os.path.exists(ck)
+    pool = runner.pack_jobs([r.events for r in requests],
+                            content_keys=True)
+    like = (runner.init_batch(),
+            runner.init_stream(pool, tenants=TENANTS))
+    (sR, stR), meta = load_state(ck, like)
+    assert meta["serve_schema"] == SERVE_SCHEMA_VERSION
+    assert int(stR.jobs_done) < int(ref_stream.jobs_done)
+    _, stB, repB = serve_run(runner, requests, policy="edf", stretch=3,
+                             drain_chunk=16, exec_cache=exec_cache,
+                             state=sR, stream=stR)
+    assert not repB["killed"]
+    rowsB = {r["job"]: r for r in runner.stream_results(stB)}
+    assert rowsB == {r["job"]: r for r in ref_rows}
+    for name in stB._fields:
+        a = np.asarray(getattr(stB, name))
+        b = np.asarray(getattr(ref_stream, name))
+        assert np.array_equal(a, b), (name, a, b)
+
+
+def test_exec_cache_disk_roundtrip(runner, requests, exec_cache,
+                                   serve_ref):
+    """A RESTARTED server (fresh ExecutableCache on the same directory —
+    empty memory plane) re-materializes the serve step from the
+    persisted jax.export artifact instead of re-tracing, and the
+    deserialized executable produces bit-identical rows."""
+    _, _, _, ref_rows = serve_ref
+    ec2 = ExecutableCache(exec_cache.path)
+    _, st2, rep2 = serve_run(runner, requests, policy="edf", stretch=3,
+                             drain_chunk=16, exec_cache=ec2)
+    assert rep2["warmup_source"] == "disk", ec2.last
+    assert {r["job"]: r for r in runner.stream_results(st2)} \
+        == {r["job"]: r for r in ref_rows}
+
+
+def test_warm_summary_cache_serves_at_ingest(requests, tmp_path):
+    """A warm SummaryCache turns every request into ingest-time service:
+    the second run burns zero lanes (and needs no executable at all) yet
+    returns the first run's rows bit-identically."""
+    cache = str(tmp_path / "memo.jsonl")
+
+    def mk():
+        r = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync",
+                          memo_cache=cache)
+        return r
+
+    r1 = mk()
+    ec = ExecutableCache(None)
+    _, st1, rep1 = serve_run(r1, requests, policy="edf", stretch=2,
+                             drain_chunk=8, exec_cache=ec)
+    rows1 = {r["job"]: r for r in r1.stream_results(st1)}
+    r2 = mk()
+    _, st2, rep2 = serve_run(r2, requests, policy="edf", stretch=2,
+                             drain_chunk=8, exec_cache=ec)
+    assert rep2["exec_jobs"] == 0 and rep2["served_cache"] == J
+    assert rep2["memo_hit_rate"] == 1.0 and rep2["steps"] == 0
+    rows2 = {r["job"]: r for r in r2.stream_results(st2)}
+    assert {j: _strip(r) for j, r in rows2.items()} \
+        == {j: _strip(r) for j, r in rows1.items()}
+    for r in rows2.values():
+        assert r["served_from"] == "cache"
+
+
+@pytest.mark.slow
+def test_serve_deep_quota_differential():
+    """The deepest serve differential: a bigger heavy-tailed trace with a
+    flooding tenant under quota, both policies, against the solo stream
+    oracle — refusals must hit only the quota'd tenant (no starvation),
+    and every served row must stay bit-identical to the plain path."""
+    reqs = serve_workload(TOPO, 24, seed=11, rate=1.0, tenants=4,
+                          priorities=3, deadline_slack=(32, 128),
+                          dup_rate=0.4, base_phases=3, max_phases=12)
+    quotas = [3, 0, 2, 0]
+    runner = BatchedRunner(TOPO, CFG, _delay(), B, scheduler="sync")
+    pool = runner.pack_jobs([r.events for r in reqs], content_keys=True)
+    _, st_ref = runner.run_stream(pool, stretch=3, drain_chunk=16)
+    base = {r["job"]: r for r in runner.stream_results(st_ref)}
+
+    per_tenant = {t: sum(1 for r in reqs if r.tenant == t)
+                  for t in range(4)}
+    ec = ExecutableCache(None)
+    reports = {}
+    for policy in ("edf", "fifo"):
+        _, st, rep = serve_run(runner, reqs, policy=policy, quotas=quotas,
+                               stretch=3, drain_chunk=16, exec_cache=ec)
+        reports[policy] = rep
+        rows = runner.stream_results(st)
+        refused = {int(t): c for t, c in rep["refused_by_tenant"].items()}
+        # quota-free tenants are never starved by the flood
+        assert all(t in (0, 2) for t in refused), refused
+        for t, q in enumerate(quotas):
+            if q and per_tenant[t] > q:
+                assert refused.get(t) == per_tenant[t] - q
+        assert rep["served_total"] == 24 - rep["refused_total"]
+        assert len(rows) == rep["served_total"]
+        served_jobs = {r["job"] for r in rows}
+        for t in (1, 3):
+            for r in reqs:
+                if r.tenant == t:
+                    assert r.job in served_jobs, (t, r.job)
+        for row in rows:
+            assert _strip(row) == _strip(base[row["job"]]), row["job"]
+    # both policies admit the same accepted set, so the books agree
+    assert reports["edf"]["refused_by_tenant"] \
+        == reports["fifo"]["refused_by_tenant"]
+    assert reports["edf"]["served_total"] == reports["fifo"]["served_total"]

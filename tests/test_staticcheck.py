@@ -154,6 +154,79 @@ def test_memo_schema_single_named_constant():
     )}) == []
 
 
+_SERVE_KNOB_OK = (
+    "ENGINE_KNOBS = {\n"
+    "    \"memo\": (\"off\", \"admit\", \"full\"),\n"
+    "    \"serve_policy\": (\"edf\", \"fifo\"),\n"
+    "}\n"
+)
+_RESOLVE_SERVE_OK = (
+    "from chandy_lamport_tpu.config import ENGINE_KNOBS\n"
+    "def resolve_serve_policy(policy):\n"
+    "    if policy not in ENGINE_KNOBS[\"serve_policy\"]:\n"
+    "        raise ValueError(policy)\n"
+    "    return policy\n"
+)
+
+
+def test_serve_knob_requires_table_row_and_default_order():
+    # missing row
+    vs = ast_lint.check_serve_knob({
+        ast_lint.CONFIG_PATH: "ENGINE_KNOBS = {\"memo\": (\"off\",)}\n",
+        "chandy_lamport_tpu/serving/admission.py": _RESOLVE_SERVE_OK})
+    assert any("no 'serve_policy' row" in v.detail for v in vs), \
+        [v.detail for v in vs]
+    # row present but reordered: edf (the default) must lead
+    vs = ast_lint.check_serve_knob({
+        ast_lint.CONFIG_PATH:
+            "ENGINE_KNOBS = {\"serve_policy\": (\"fifo\", \"edf\")}\n",
+        "chandy_lamport_tpu/serving/admission.py": _RESOLVE_SERVE_OK})
+    assert any("'edf' leads" in v.detail for v in vs), [v.detail for v in vs]
+    # the clean shape passes
+    assert ast_lint.check_serve_knob({
+        ast_lint.CONFIG_PATH: _SERVE_KNOB_OK,
+        "chandy_lamport_tpu/serving/admission.py": _RESOLVE_SERVE_OK}) == []
+
+
+def test_serve_knob_rejects_inline_spelling_copy():
+    bad_resolver = (
+        "def resolve_serve_policy(policy):\n"
+        "    if policy not in (\"edf\", \"fifo\"):\n"
+        "        raise ValueError(policy)\n"
+        "    return policy\n"
+    )
+    vs = ast_lint.check_serve_knob({
+        ast_lint.CONFIG_PATH: _SERVE_KNOB_OK,
+        "chandy_lamport_tpu/serving/admission.py": bad_resolver})
+    details = [v.detail for v in vs]
+    assert any("does not consult ENGINE_KNOBS" in d for d in details), details
+    assert any("restates the policy spellings inline" in d
+               for d in details), details
+
+
+def test_serve_schema_single_named_constant():
+    # restated literal at a serve_schema stamp site
+    vs = ast_lint.check_serve_schema({ast_lint.SERVING_SERVER_PATH: (
+        "SERVE_SCHEMA_VERSION = 1\n"
+        "def row():\n"
+        "    return {\"serve_schema\": 1, \"kind\": \"serve_interval\"}\n"
+    )})
+    assert any("restated literal 1" in v.detail for v in vs), \
+        [v.detail for v in vs]
+    # re-assignment outside serving/server.py
+    vs = ast_lint.check_serve_schema({
+        ast_lint.SERVING_SERVER_PATH: "SERVE_SCHEMA_VERSION = 1\n",
+        "chandy_lamport_tpu/cli.py": "SERVE_SCHEMA_VERSION = 2\n"})
+    assert any("lives only in serving/server.py" in v.detail
+               for v in vs), [v.detail for v in vs]
+    # the clean shape (Name reference at the stamp site) passes
+    assert ast_lint.check_serve_schema({ast_lint.SERVING_SERVER_PATH: (
+        "SERVE_SCHEMA_VERSION = 1\n"
+        "def row():\n"
+        "    return {\"serve_schema\": SERVE_SCHEMA_VERSION}\n"
+    )}) == []
+
+
 def test_registry_loader_reads_legacy_and_schema2(tmp_path):
     legacy = tmp_path / "legacy.json"
     legacy.write_text(json.dumps({"k": "abc"}))

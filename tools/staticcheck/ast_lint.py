@@ -47,6 +47,16 @@ Rules (ids are stable; the README rule table documents them):
                       dict there references the Name (a restated literal
                       would let the written and checked versions diverge),
                       and no other module re-assigns the constant.
+  serve-knob          ENGINE_KNOBS declares ``serve_policy`` with exactly
+                      the edf/fifo pair ("edf" first — the default), and
+                      ``resolve_serve_policy`` validates against the table,
+                      not a restated inline tuple. The generic knob-pattern
+                      rule already demands the resolver/flag/bench-row
+                      trio; this rule pins the ladder itself.
+  serve-schema        SERVE_SCHEMA_VERSION is ONE module-level int literal
+                      in serving/server.py; every ``"serve_schema":``
+                      stamp in the package references the Name, and no
+                      other module re-assigns the constant.
 """
 
 from __future__ import annotations
@@ -63,10 +73,15 @@ GRAPHSHARD_PATH = "chandy_lamport_tpu/parallel/graphshard.py"
 CLI_PATH = "chandy_lamport_tpu/cli.py"
 BENCH_PATH = "chandy_lamport_tpu/bench.py"
 MEMOCACHE_PATH = "chandy_lamport_tpu/utils/memocache.py"
+SERVING_SERVER_PATH = "chandy_lamport_tpu/serving/server.py"
 
 # the memo opt-in ladder; "off" first — the table order IS the contract
 # (off is the default and the bit-identity baseline)
 MEMO_SPELLINGS = ("off", "admit", "full")
+
+# the serving admission policies; "edf" first — the default the serve
+# CLI/bench run unless the baseline is asked for explicitly
+SERVE_SPELLINGS = ("edf", "fifo")
 
 # modules whose function bodies are traced into jaxprs (directly or via the
 # kernels/runners) — host clock/RNG imports are banned here
@@ -638,6 +653,164 @@ def check_memo_schema(sources: Dict[str, str]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# serve-knob
+
+
+def check_serve_knob(sources: Dict[str, str]) -> List[Violation]:
+    """The serving policy spellings live in ENGINE_KNOBS and nowhere
+    else: the table row must be exactly the edf/fifo pair (edf first —
+    it is the default), and ``resolve_serve_policy`` must consult the
+    table by Name instead of restating the spellings in an inline
+    tuple/list/set that would drift when a third policy lands. (The
+    generic knob-pattern rule already demands the resolver, the
+    ``--serve-policy`` flag and the bench row key.)"""
+    out: List[Violation] = []
+    tree = _parse(sources, CONFIG_PATH)
+    if tree is None:
+        return out
+    row: Optional[Tuple[ast.expr, int]] = None
+    for node in tree.body:
+        value = _assign_value(node)
+        if "ENGINE_KNOBS" in _assign_targets(node) and \
+                isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and k.value == "serve_policy":
+                    row = (v, k.lineno)
+    if row is None:
+        return [Violation(
+            "serve-knob", CONFIG_PATH,
+            "ENGINE_KNOBS has no 'serve_policy' row — the admission "
+            "policies must be declared in the knob table like every other "
+            "engine knob")]
+    row_value, row_line = row
+    spellings = tuple(
+        e.value for e in getattr(row_value, "elts", [])
+        if isinstance(e, ast.Constant))
+    if spellings != SERVE_SPELLINGS:
+        out.append(Violation(
+            "serve-knob", f"{CONFIG_PATH}:{row_line}",
+            f"ENGINE_KNOBS['serve_policy'] = {spellings!r}, expected "
+            f"{SERVE_SPELLINGS!r} — 'edf' leads (it is the default) and "
+            f"'fifo' is the arrival-order bench baseline"))
+
+    resolver: Optional[Tuple[str, ast.FunctionDef]] = None
+    for path, src in sources.items():
+        if not path.startswith("chandy_lamport_tpu/"):
+            continue
+        try:
+            t = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(t):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == "resolve_serve_policy":
+                resolver = (path, node)
+    if resolver is None:
+        # knob-pattern already reports the missing resolver
+        return out
+    rpath, rnode = resolver
+    if not any(isinstance(n, ast.Name) and n.id == "ENGINE_KNOBS"
+               for n in ast.walk(rnode)):
+        out.append(Violation(
+            "serve-knob", f"{rpath}:{rnode.lineno}",
+            "resolve_serve_policy does not consult ENGINE_KNOBS — the "
+            "accepted spellings must come from the table, not a local "
+            "copy"))
+    for n in ast.walk(rnode):
+        if isinstance(n, (ast.Tuple, ast.List, ast.Set)):
+            inline = {e.value for e in n.elts
+                      if isinstance(e, ast.Constant)}
+            if {"edf", "fifo"} <= inline:
+                out.append(Violation(
+                    "serve-knob", f"{rpath}:{n.lineno}",
+                    f"resolve_serve_policy restates the policy spellings "
+                    f"inline ({sorted(inline)}) — validate against "
+                    f"ENGINE_KNOBS['serve_policy'] so they have one home"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serve-schema
+
+
+def check_serve_schema(sources: Dict[str, str]) -> List[Violation]:
+    """SERVE_SCHEMA_VERSION is a single named registry constant: one
+    module-level int-literal assignment in serving/server.py, referenced
+    by Name from every ``"serve_schema":``-stamping dict in the package
+    (telemetry rows, checkpoint meta, report — a restated literal lets
+    the written and the checked version diverge across a bump), and
+    never re-assigned an int literal in any other module."""
+    out: List[Violation] = []
+    for path, src in sorted(sources.items()):
+        if path == SERVING_SERVER_PATH:
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            value = _assign_value(node)
+            if "SERVE_SCHEMA_VERSION" in _assign_targets(node) and \
+                    isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int):
+                out.append(Violation(
+                    "serve-schema", f"{path}:{node.lineno}",
+                    f"SERVE_SCHEMA_VERSION = {value.value}: the serve "
+                    f"schema version lives only in serving/server.py — "
+                    f"import it, don't shadow it"))
+
+    tree = _parse(sources, SERVING_SERVER_PATH)
+    if tree is None:
+        return out + [Violation(
+            "serve-schema", SERVING_SERVER_PATH,
+            "serving/server.py not found in lint input")]
+    decls: List[Tuple[ast.stmt, Optional[ast.expr]]] = []
+    for node in tree.body:
+        if "SERVE_SCHEMA_VERSION" in _assign_targets(node):
+            decls.append((node, _assign_value(node)))
+    if not decls:
+        out.append(Violation(
+            "serve-schema", SERVING_SERVER_PATH,
+            "no module-level SERVE_SCHEMA_VERSION — the serve row format "
+            "needs one named registry constant"))
+    elif len(decls) > 1:
+        out.append(Violation(
+            "serve-schema", f"{SERVING_SERVER_PATH}:{decls[1][0].lineno}",
+            "SERVE_SCHEMA_VERSION assigned more than once — one "
+            "declaration, one value"))
+    else:
+        value = decls[0][1]
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, int)):
+            out.append(Violation(
+                "serve-schema",
+                f"{SERVING_SERVER_PATH}:{decls[0][0].lineno}",
+                "SERVE_SCHEMA_VERSION must be a bare int literal — a "
+                "computed version can change without a reviewable diff"))
+    for path, src in sorted(sources.items()):
+        if not path.startswith("chandy_lamport_tpu/"):
+            continue
+        try:
+            t = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(t):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and \
+                        k.value == "serve_schema" and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    out.append(Violation(
+                        "serve-schema", f"{path}:{v.lineno}",
+                        f"serve_schema stamped with restated literal "
+                        f"{v.value} — reference SERVE_SCHEMA_VERSION so "
+                        f"write and check sites cannot diverge"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 ALL_RULES = (
@@ -649,6 +822,8 @@ ALL_RULES = (
     check_scatter_mode,
     check_memo_knob,
     check_memo_schema,
+    check_serve_knob,
+    check_serve_schema,
 )
 
 

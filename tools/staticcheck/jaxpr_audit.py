@@ -256,6 +256,36 @@ def _stream_entry(memo: str = "off") -> Entry:
                  jit_fn=step, donated=(0, 1), state_out=False)
 
 
+def _serve_entry() -> Entry:
+    import jax
+    import jax.numpy as jnp
+    from chandy_lamport_tpu.models.workloads import stream_jobs
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    runner = _batch_runner("sync")
+    jobs = stream_jobs(ring_topology(8, tokens=16), 4, seed=5,
+                       base_phases=2, max_phases=4)
+    pool = runner.pack_jobs(jobs, content_keys=True)
+    stream = runner.init_stream(pool, tenants=2,
+                                tenant_quota=[0, 2])
+    state = runner.init_batch()
+    pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
+    step = runner._stream_step(2, 8, False, True)
+    # the serve step adds the host-side admission indirection on top of
+    # the memo signature: an exec-order array walked only up to the
+    # dynamic ``limit`` scalar, plus per-job tenant/arrival/deadline
+    # constants feeding the harvest-side books (deadline misses, tenant
+    # scatter-add). followers is unused in serve mode (None subtree).
+    j = len(jobs)
+    order = jnp.arange(j, dtype=jnp.int32)
+    tenant_of = jnp.zeros((j,), jnp.int32).at[1::2].set(1)
+    arrival_of = jnp.zeros((j,), jnp.int32)
+    deadline_of = jnp.full((j,), 64, jnp.int32)
+    return Entry(key="batch.stream.step.serve", fn=step,
+                 args=(state, stream, pool_dev, order, None,
+                       jnp.int32(j), tenant_of, arrival_of, deadline_of),
+                 jit_fn=step, donated=(0, 1), state_out=False)
+
+
 def _graphshard_entry(comm_engine: str) -> Entry:
     import jax
     import numpy as np
@@ -310,9 +340,11 @@ def iter_entry_builders(mode: str = "full"):
     queue_engine {gather,mask} x kernel_engine {xla,pallas} x faults x
     trace (fold skips faulted arms: the specification form refuses the
     fault engine), the sync tick over the same engine arms, the loop/
-    inject entries, both storm schedulers, the stream step (plain and
-    under memo="full", which adds the rolling state-signature plane),
-    both graphshard comm engines, and the Pallas kernels under interpret.
+    inject entries, both storm schedulers, the stream step (plain, under
+    memo="full" — which adds the rolling state-signature plane — and
+    under serve=True, which adds the bounded exec-order admission plus
+    deadline/tenant harvest books), both graphshard comm engines, and
+    the Pallas kernels under interpret.
 
     fast — one arm per engine axis on the same tiny graphs: enough for
     tier-1 to prove the audit machinery against live traces without
@@ -364,6 +396,7 @@ def iter_entry_builders(mode: str = "full"):
             lambda s=scheduler: _storm_entry(s))
     yield "batch.stream.step", _stream_entry
     yield "batch.stream.step.memo=full", (lambda: _stream_entry("full"))
+    yield "batch.stream.step.serve", _serve_entry
     for comm in ("dense", "sparse"):
         yield f"graphshard.dispatch.comm={comm}", (
             lambda c=comm: _graphshard_entry(c))
