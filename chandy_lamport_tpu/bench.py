@@ -710,6 +710,13 @@ def run_worker(args) -> int:
             "snapshot_every": args.snapshot_every}
            if (args.snapshot_timeout or args.snapshot_every) else {}),
     }
+    # the analytic roofline the measured rate reads against (and the
+    # static sibling of tools/staticcheck's per-arm HLO cost rows)
+    from chandy_lamport_tpu.utils.metrics import tick_cost_model
+
+    result["cost_model"] = tick_cost_model(
+        topo.n, topo.e, cfg, batch=args.batch,
+        queue_engine=runner.queue_engine)
     result.update(trace_extra)
     result.update(mem)
     if dev.platform != "tpu":
@@ -891,6 +898,11 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
         # effective rate IS its execution rate (the memo arm overrides)
         "effective_jobs_per_sec": round(best["stream"], 2),
     }
+    from chandy_lamport_tpu.utils.metrics import tick_cost_model
+
+    result["cost_model"] = tick_cost_model(
+        runner.topo.n, runner.topo.e, cfg, batch=args.batch,
+        queue_engine=runner.queue_engine)
     if args.memo != "off":
         # memo arm: same pool, same knobs, memo plane on — the headline is
         # effective jobs SERVED per second vs the memo-off arm above
@@ -1080,6 +1092,14 @@ def run_serve_worker(args, dev, spec, cfg) -> int:
         "admit_p99_fifo": rep_fifo["admit_p99"],
         "occupancy_fifo": rep_fifo["occupancy"],
     }
+    from chandy_lamport_tpu.core.state import DenseTopology
+    from chandy_lamport_tpu.ops.tick import resolve_queue_engine
+    from chandy_lamport_tpu.utils.metrics import tick_cost_model
+
+    topo = DenseTopology(spec)
+    result["cost_model"] = tick_cost_model(
+        topo.n, topo.e, cfg, batch=args.batch,
+        queue_engine=resolve_queue_engine(args.queue_engine))
     result.update(mem)
     if dev.platform != "tpu":
         deliberate = (os.environ.get("CLSIM_PLATFORM") == "cpu"

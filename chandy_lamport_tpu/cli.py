@@ -353,12 +353,17 @@ def _cmd_stream(args) -> int:
         from chandy_lamport_tpu.utils.tracing import JaxTrace
 
         trace = JaxTrace(capacity=args.trace_capacity)
+    guards = None
+    if args.guards:
+        from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+        guards = RuntimeGuards()
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            kernel_engine=args.kernel_engine,
                            faults=faults, quarantine=faults is not None,
                            trace=trace, memo=args.memo,
-                           memo_cache=args.memo_cache)
+                           memo_cache=args.memo_cache, guards=guards)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=args.seed,
                        base_phases=args.base_phases,
@@ -413,6 +418,8 @@ def _cmd_stream(args) -> int:
 
         tr_rec, tr_drop = trace_counts(state)
         row["trace_events"], row["trace_dropped"] = tr_rec, tr_drop
+    if guards is not None:
+        row["guards"] = guards.books()
     if args.telemetry:
         from chandy_lamport_tpu.utils.tracing import TelemetryWriter
 
@@ -452,12 +459,18 @@ def _cmd_serve(args) -> int:
     spec = gen()
     cfg = SimConfig.for_workload(snapshots=args.snapshots,
                                  split_markers=args.scheduler == "sync")
+    guards = None
+    if args.guards:
+        from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+        guards = RuntimeGuards()
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            kernel_engine=args.kernel_engine,
                            memo_cache=args.memo_cache,
                            memo_cache_entries=args.memo_cache_entries,
-                           memo_cache_bytes=args.memo_cache_bytes)
+                           memo_cache_bytes=args.memo_cache_bytes,
+                           guards=guards)
     rcount = args.requests or 3 * args.batch
     quotas = ([int(x) for x in args.quota.split(",")] if args.quota
               else None)
@@ -526,6 +539,8 @@ def _cmd_serve(args) -> int:
     if errored:
         row["job_errors"] = {r["job"]: r["errors_decoded"]
                              for r in errored[:16]}
+    if guards is not None:
+        row["guards"] = guards.books()
     if args.telemetry:
         row["telemetry"] = args.telemetry
     print(json.dumps(row))
@@ -794,6 +809,13 @@ def main(argv=None) -> int:
     pq.add_argument("--telemetry", metavar="PATH",
                     help="append a stream_run row plus one stream_job row "
                          "per harvested job as schema-versioned JSONL")
+    pq.add_argument("--guards", action="store_true",
+                    help="arm the runtime contract sentry "
+                         "(utils/guards.RuntimeGuards): the steady-state "
+                         "loop runs under jax.transfer_guard('disallow') + "
+                         "jax.checking_leaks with a compile-event counter; "
+                         "adds a guards (compiles + per-site transfer) "
+                         "books dict to the JSON row")
     pq.set_defaults(fn=_cmd_stream)
 
     pz = sub.add_parser("serve", help="online multi-tenant serving over "
@@ -879,6 +901,10 @@ def main(argv=None) -> int:
                          "one serve_job row per served request")
     pz.add_argument("--telemetry-interval", type=int, default=64,
                     metavar="K")
+    pz.add_argument("--guards", action="store_true",
+                    help="arm the runtime contract sentry "
+                         "(utils/guards.RuntimeGuards) around the serve "
+                         "loop; adds a guards books dict to the JSON row")
     pz.set_defaults(fn=_cmd_serve)
 
     pb = sub.add_parser(

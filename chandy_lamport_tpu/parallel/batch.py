@@ -46,6 +46,12 @@ from chandy_lamport_tpu.ops.tick import (
     harvest_lane_summaries,
     reset_lanes,
 )
+from chandy_lamport_tpu.utils.guards import (
+    armed,
+    guarded_get,
+    guarded_put,
+    relaxed_site,
+)
 from chandy_lamport_tpu.utils.memocache import (
     MemoCacheError,
     SummaryCache,
@@ -351,7 +357,8 @@ class BatchedRunner:
                  kernel_engine: Optional[str] = None, faults=None,
                  quarantine: bool = False, trace=None,
                  memo: str = "off", memo_cache: Optional[str] = None,
-                 memo_cache_entries: int = 0, memo_cache_bytes: int = 0):
+                 memo_cache_entries: int = 0, memo_cache_bytes: int = 0,
+                 guards=None):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -447,9 +454,17 @@ class BatchedRunner:
         cache in-memory per run, so only coalescing and fast-forwarding
         apply across one call). ``memo_cache_entries``/
         ``memo_cache_bytes``: LRU capacity bounds for that cache
-        (SummaryCache docstring; 0 = unbounded)."""
+        (SummaryCache docstring; 0 = unbounded).
+
+        guards: utils/guards.RuntimeGuards — opt-in runtime contract
+        sentry. When set, ``run_stream`` arms transfer_guard/leak
+        checking/the compile counter around its steady-state device
+        loop, and every intentional host sync goes through a named
+        site (``guards.books()``). None (default) is the unguarded
+        engine — identical code path, no accounting."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
+        self.guards = guards
         self.memo = resolve_memo(memo)
         self.memo_cache_path = memo_cache
         self.memo_cache_entries = int(memo_cache_entries)
@@ -1418,10 +1433,9 @@ class BatchedRunner:
         ERR_TICK_LIMIT edge replays tick-exactly. ``seen`` maps lane ->
         (key, time at last sighting) and persists across steps; any
         cursor/job change resets the watch."""
-        jid = np.asarray(state.job_id)
-        cur = np.asarray(state.prog_cursor)
-        sig = np.asarray(state.sig)
-        tnow = np.asarray(state.time)
+        jid, cur, sig, tnow = guarded_get(
+            self.guards, "memo-fastforward",
+            (state.job_id, state.prog_cursor, state.sig, state.time))
         jend = np.asarray(pool.job_end)
         jlim = np.asarray(pool.job_limit)
         skips = np.zeros(self.batch, np.int32)
@@ -1642,36 +1656,52 @@ class BatchedRunner:
         # (the armed-deadline fence in _ff_step covers snapshot_timeout)
         ff = memo == "full" and self.config.snapshot_every == 0
         ff_seen: dict = {}
+        guards = self.guards
+        # the carry enters the device through an explicit named bulk
+        # upload (init_batch builds host numpy leaves; the armed loop
+        # forbids the implicit h2d the first dispatch used to do)
+        state, stream = guarded_put(guards, "stream-carry-upload",
+                                    (state, stream))
         saves = 0
-        done = int(stream.jobs_done)
+        done = int(guarded_get(guards, "stream-termination-scalars",
+                               stream.jobs_done))
         if done < target:
-            for _ in range(int(max_steps)):
-                if memo == "off":
-                    state, stream = step(state, stream, pool_dev)
+            # the steady-state device loop runs armed when guards are on:
+            # implicit transfers raise, compiles are booked as retraces,
+            # and the only host syncs are the named sites below
+            with armed(guards):
+                for _ in range(int(max_steps)):
+                    if memo == "off":
+                        state, stream = step(state, stream, pool_dev)
+                    else:
+                        state, stream = step(state, stream, pool_dev,
+                                             order_dev, followers_dev)
+                    if ff:
+                        state, stream = self._ff_host(state, stream, pool,
+                                                      ff_seen)
+                    done, steps_now = (int(x) for x in guarded_get(
+                        guards, "stream-termination-scalars",
+                        (stream.jobs_done, stream.steps)))
+                    if (checkpoint and checkpoint_every
+                            and steps_now % int(checkpoint_every) == 0):
+                        # save_state numpy-ifies the whole carry; an
+                        # intentional bulk transfer, booked by site
+                        with relaxed_site(guards, "checkpoint-save"):
+                            save_state(checkpoint, (state, stream),
+                                       meta={"stream_steps": steps_now,
+                                             "jobs_done": done})
+                        saves += 1
+                        if kill_after_saves is not None \
+                                and saves >= int(kill_after_saves):
+                            return state, stream
+                    if done >= target:
+                        break
                 else:
-                    state, stream = step(state, stream, pool_dev,
-                                         order_dev, followers_dev)
-                if ff:
-                    state, stream = self._ff_host(state, stream, pool,
-                                                  ff_seen)
-                done = int(stream.jobs_done)
-                if (checkpoint and checkpoint_every
-                        and int(stream.steps) % int(checkpoint_every) == 0):
-                    save_state(checkpoint, (state, stream),
-                               meta={"stream_steps": int(stream.steps),
-                                     "jobs_done": done})
-                    saves += 1
-                    if kill_after_saves is not None \
-                            and saves >= int(kill_after_saves):
-                        return state, stream
-                if done >= target:
-                    break
-            else:
-                raise RuntimeError(
-                    f"run_stream: {target - done} of {target} executed jobs "
-                    f"unfinished after {max_steps} steps — raise max_steps "
-                    f"(or a lane is stuck, which the stage machine should "
-                    f"make impossible)")
+                    raise RuntimeError(
+                        f"run_stream: {target - done} of {target} executed "
+                        f"jobs unfinished after {max_steps} steps — raise "
+                        f"max_steps (or a lane is stuck, which the stage "
+                        f"machine should make impossible)")
         if memo != "off":
             state, stream = self._memo_finalize(state, stream, plan)
         return state, stream

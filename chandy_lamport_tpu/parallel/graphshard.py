@@ -52,6 +52,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.utils.guards import armed
 from chandy_lamport_tpu.core.state import (
     ERR_CONSERVATION,
     ERR_QUEUE_OVERFLOW,
@@ -276,7 +277,7 @@ class GraphShardedRunner:
                  check_every: int = 0, queue_engine: str = "auto",
                  comm_engine: Optional[str] = None,
                  kernel_engine: Optional[str] = None, megatick: int = 1,
-                 quarantine: bool = False, trace=None):
+                 quarantine: bool = False, trace=None, guards=None):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -321,6 +322,11 @@ class GraphShardedRunner:
         INJECTION stays a dense/batched-path feature — ShardedState
         carries no adversary leaves.
 
+        guards: utils/guards.RuntimeGuards — opt-in runtime contract
+        sentry (BatchedRunner docstring): arms transfer_guard / leak
+        checking / the compile counter around the storm and script
+        dispatches. None (default) changes nothing.
+
         trace: utils/tracing.JaxTrace — arm the replicated flight
         recorder: snapshot lifecycle (start/end) and supervisor actions
         (abort/retry/fail) append to the replicated trace ring (the
@@ -328,6 +334,7 @@ class GraphShardedRunner:
         stay out). None (default) compiles the trace ops away."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
+        self.guards = guards
         self.mesh = mesh
         self.axis = axis
         self.shards = mesh.shape[axis]
@@ -1272,16 +1279,23 @@ class GraphShardedRunner:
         sync scheduler) + drain + flush, SPMD over the graph mesh. With
         fixed_delay this is bit-comparable to the unsharded sync backend
         (tests/test_graphshard_script.py)."""
-        return self._run_script(state, self.stopo_device(),
-                                self.compile_script(events))
+        script = self.compile_script(events)
+        stopo = self.stopo_device()
+        with armed(self.guards):
+            return self._run_script(state, stopo, script)
 
     def run_storm(self, state: ShardedState, amounts: np.ndarray,
                   snap: np.ndarray) -> ShardedState:
         """amounts [T, E] (global edge order), snap [T, J]: runs the full
-        program + drain + flush SPMD over the graph mesh."""
+        program + drain + flush SPMD over the graph mesh. The dispatch
+        runs armed when ``guards`` is set (utils/guards): program shards
+        are device_put by shard_program/stopo_device BEFORE arming, so a
+        steady storm cadence is transfer- and retrace-silent."""
         amounts_s, snap_r = self.shard_program(np.asarray(amounts),
                                                np.asarray(snap))
-        return self._run(state, self.stopo_device(), (amounts_s, snap_r))
+        stopo = self.stopo_device()
+        with armed(self.guards):
+            return self._run(state, stopo, (amounts_s, snap_r))
 
     # -- combined data x graph mode: B lanes of giant sharded instances ----
 

@@ -50,6 +50,7 @@ import jax
 import numpy as np
 from jax import export as jax_export
 
+from chandy_lamport_tpu.utils.filelock import locked
 from chandy_lamport_tpu.utils.memocache import _canon
 
 EXEC_CACHE_SCHEMA_VERSION = 1
@@ -167,8 +168,10 @@ class ExecutableCache:
         if apath and os.path.exists(apath):
             try:
                 _register_serialization()
-                with open(apath, "rb") as f:
-                    exported = jax_export.deserialize(bytearray(f.read()))
+                with locked(apath, shared=True):
+                    with open(apath, "rb") as f:
+                        blob = bytearray(f.read())
+                exported = jax_export.deserialize(blob)
                 fn = jax.jit(exported.call, donate_argnums=(0, 1))
                 call = fn.lower(*abstract).compile()
                 source = "disk"
@@ -193,16 +196,19 @@ class ExecutableCache:
     @staticmethod
     def _persist(apath: str, fn, abstract) -> tuple:
         """Best-effort export of the lowered program, written atomically
-        (tmp + rename) so a killed server never leaves a torn artifact."""
+        (tmp + rename) under an exclusive advisory lock (utils/filelock)
+        so a killed server never leaves a torn artifact and two servers
+        exporting the same bucket never race the rename."""
         try:
             _register_serialization()
             exported = jax_export.export(fn)(*abstract)
             blob = exported.serialize()
             os.makedirs(os.path.dirname(apath) or ".", exist_ok=True)
             tmp = apath + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, apath)
+            with locked(apath):
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, apath)
             return True, None
         except Exception as exc:
             return False, f"{type(exc).__name__}: {exc}"

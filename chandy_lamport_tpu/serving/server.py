@@ -61,6 +61,12 @@ from chandy_lamport_tpu.serving.admission import (
     resolve_serve_policy,
 )
 from chandy_lamport_tpu.serving.executables import ExecutableCache
+from chandy_lamport_tpu.utils.guards import (
+    armed,
+    guarded_get,
+    guarded_put,
+    relaxed_site,
+)
 
 SERVE_SCHEMA_VERSION = 1
 
@@ -85,7 +91,8 @@ def serve_run(runner, requests: List[ServeRequest], *,
               checkpoint_every: int = 0,
               kill_after_saves: Optional[int] = None,
               telemetry=None, telemetry_interval: int = 64,
-              exec_cache: Optional[ExecutableCache] = None):
+              exec_cache: Optional[ExecutableCache] = None,
+              guards=None):
     """Serve a timed request schedule; returns ``(state, stream, report)``.
 
     ``requests`` must be serve_workload-style: ``job`` equal to list
@@ -99,10 +106,15 @@ def serve_run(runner, requests: List[ServeRequest], *,
     ``serve_run`` row, each stamped with SERVE_SCHEMA_VERSION. Results
     come from ``runner.stream_results(stream)`` as usual; refused
     requests get no row (the report carries per-tenant refusal counts).
+    ``guards``: utils/guards.RuntimeGuards arming the device loop
+    (defaults to the runner's own ``guards``); every intentional
+    host<->device transfer in the loop goes through a named site.
     """
     from chandy_lamport_tpu.utils.checkpoint import save_state
 
     policy = resolve_serve_policy(policy)
+    if guards is None:
+        guards = getattr(runner, "guards", None)
     if stretch < 1 or drain_chunk < 1:
         raise ValueError("stretch and drain_chunk must be >= 1")
     total = len(requests)
@@ -249,53 +261,70 @@ def serve_run(runner, requests: List[ServeRequest], *,
         telemetry.write(kind, row)
 
     # -- the device loop -------------------------------------------------
+    # armed when guards are on: the AOT step never retraces (shape-
+    # bucketed executable), the exec-order/limit operands go to device
+    # through named put sites, and the one sync per iteration is a named
+    # get site — anything else raises under transfer_guard("disallow").
+    # The carry enters the device through an explicit named bulk upload
+    # first (a fresh start builds host numpy leaves).
+    state, stream = guarded_put(guards, "serve-carry-upload",
+                                (state, stream))
     saves = 0
     t_loop = time.perf_counter()
-    while done_exec < n_exec:
-        if steps_now >= max_steps:
-            raise RuntimeError(
-                f"serve_run: {n_exec - done_exec} of {n_exec} executed "
-                f"jobs unfinished after {max_steps} steps — raise "
-                f"max_steps")
-        elig = order_eligible([requests[j] for j in sorted(pending)],
-                              policy)
-        exec_order[consumed:consumed + len(elig)] = \
-            np.asarray([r.job for r in elig], np.int32)
-        limit = consumed + len(elig)
-        # dispatch is async; the arrivals for the NEXT host time are
-        # ingested while the device steps (double buffering), and only
-        # the scalar read below synchronizes
-        state, stream = call(state, stream, pool_dev,
-                             jnp.asarray(exec_order), None,
-                             np.int32(limit), tenant_dev, arrival_dev,
-                             deadline_dev)
-        ingest_upto(steps_now + 1)
-        prev = consumed
-        consumed, steps_now, done_exec = (int(x) for x in jax.device_get(
-            (stream.next_job, stream.steps, stream.jobs_done)))
-        for pos in range(prev, consumed):
-            j = int(exec_order[pos])
-            admitted.add(j)
-            pending.discard(j)
-            lat = (steps_now - 1) - int(arrival_host[j])
-            admit_all.append(lat)
-            admit_window.append(lat)
-        if (telemetry is not None and telemetry_interval
-                and steps_now % int(telemetry_interval) == 0):
-            telemetry_row("serve_interval", _percentiles(admit_window))
-            admit_window = []
-        if (checkpoint and checkpoint_every
-                and steps_now % int(checkpoint_every) == 0):
-            save_state(checkpoint, (state, stream),
-                       meta={"stream_steps": steps_now,
-                             "jobs_done": done_exec,
-                             "serve_schema": SERVE_SCHEMA_VERSION})
-            saves += 1
-            if kill_after_saves is not None \
-                    and saves >= int(kill_after_saves):
-                return state, stream, {
-                    "serve_schema": SERVE_SCHEMA_VERSION, "killed": True,
-                    "steps": steps_now, "saves": saves, **warm}
+    with armed(guards):
+        while done_exec < n_exec:
+            if steps_now >= max_steps:
+                raise RuntimeError(
+                    f"serve_run: {n_exec - done_exec} of {n_exec} executed "
+                    f"jobs unfinished after {max_steps} steps — raise "
+                    f"max_steps")
+            elig = order_eligible([requests[j] for j in sorted(pending)],
+                                  policy)
+            exec_order[consumed:consumed + len(elig)] = \
+                np.asarray([r.job for r in elig], np.int32)
+            limit = consumed + len(elig)
+            # dispatch is async; the arrivals for the NEXT host time are
+            # ingested while the device steps (double buffering), and only
+            # the scalar read below synchronizes
+            state, stream = call(
+                state, stream, pool_dev,
+                guarded_put(guards, "serve-admission-order", exec_order),
+                None,
+                guarded_put(guards, "serve-admission-limit",
+                            np.int32(limit)),
+                tenant_dev, arrival_dev, deadline_dev)
+            ingest_upto(steps_now + 1)
+            prev = consumed
+            consumed, steps_now, done_exec = (int(x) for x in guarded_get(
+                guards, "serve-progress-scalars",
+                (stream.next_job, stream.steps, stream.jobs_done)))
+            for pos in range(prev, consumed):
+                j = int(exec_order[pos])
+                admitted.add(j)
+                pending.discard(j)
+                lat = (steps_now - 1) - int(arrival_host[j])
+                admit_all.append(lat)
+                admit_window.append(lat)
+            if (telemetry is not None and telemetry_interval
+                    and steps_now % int(telemetry_interval) == 0):
+                telemetry_row("serve_interval", _percentiles(admit_window))
+                admit_window = []
+            if (checkpoint and checkpoint_every
+                    and steps_now % int(checkpoint_every) == 0):
+                # save_state numpy-ifies the whole carry — an intentional
+                # bulk device read, booked by site
+                with relaxed_site(guards, "checkpoint-save"):
+                    save_state(checkpoint, (state, stream),
+                               meta={"stream_steps": steps_now,
+                                     "jobs_done": done_exec,
+                                     "serve_schema": SERVE_SCHEMA_VERSION})
+                saves += 1
+                if kill_after_saves is not None \
+                        and saves >= int(kill_after_saves):
+                    return state, stream, {
+                        "serve_schema": SERVE_SCHEMA_VERSION,
+                        "killed": True, "steps": steps_now,
+                        "saves": saves, **warm}
     wall_s = time.perf_counter() - t_loop
 
     # tail arrivals past the last harvest never need the device: the

@@ -1,0 +1,169 @@
+"""Runtime contract guards: no retraces, no un-sited transfers.
+
+The streaming and serving loops claim a vectorized-MCMC-style discipline
+(PAPERS.md): compile the step once, then never sync or retrace in steady
+state. Nothing enforced it — a stray ``int(device_scalar)`` in the loop,
+a numpy operand handed to a compiled call, or a shape-dependent
+re-lowering all degrade silently. ``RuntimeGuards`` arms the three JAX
+runtime contracts around the jitted loops:
+
+  transfers   ``jax.transfer_guard("disallow")`` — implicit host<->device
+              transfers raise at the offending line. Explicit transfers
+              (``jax.device_get``/``device_put``/``jnp.asarray``) stay
+              legal, and the engine routes every intentional one through
+              a NAMED SITE (``guarded_get``/``guarded_put``) so the books
+              record exactly which sync points fired and how often.
+  leaks       ``jax.checking_leaks()`` — tracer leaks out of any trace
+              started inside the armed region raise instead of deferring
+              a crash to some unrelated later line.
+  retraces    a ``jax.monitoring`` listener counts backend-compile events
+              while armed; any compile after warmup is a retrace (new
+              shapes, new static args, a rebuilt jit) and shows up in
+              ``books()["compiles"]``.
+
+Opt-in wiring: ``BatchedRunner(..., guards=RuntimeGuards())`` arms the
+``run_stream`` loop, ``GraphShardedRunner(..., guards=...)`` the storm
+dispatch, and ``serve_run(..., guards=...)`` the serve loop (defaulting
+to the runner's). ``tools/staticcheck --plane runtime`` drives tiny
+shapes per engine-knob row through warm loops under these guards and
+fails on any retrace or un-sited transfer; the per-path site allowlists
+live there, declaratively, not as a global off switch.
+
+The module-level helpers are no-ops when ``guards`` is None, so the
+default path pays nothing (the explicit ``device_get``/``device_put``
+they always perform is what the hot loops should do anyway).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Set
+
+# guards currently inside an ``armed()`` region; the process-wide
+# monitoring listener (installed once, on first arming) fans compile
+# events out to every member. jax.monitoring has no unregister, so a
+# dispatch set is the only clean lifetime model.
+_ACTIVE: Set["RuntimeGuards"] = set()
+_LISTENER_INSTALLED = False
+
+
+def _on_compile_event(event: str, *args, **kwargs) -> None:
+    if "backend_compile" not in event:
+        return
+    for g in tuple(_ACTIVE):
+        g._compiles += 1
+
+
+def _install_listener() -> bool:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+        # backend_compile is a duration event in this jax; listen on both
+        # channels so a future move between them cannot silently zero the
+        # retrace counter
+        monitoring.register_event_listener(
+            lambda event, **kw: _on_compile_event(event))
+        monitoring.register_event_duration_secs_listener(
+            lambda event, duration, **kw: _on_compile_event(event))
+        _LISTENER_INSTALLED = True
+    except Exception:
+        _LISTENER_INSTALLED = False
+    return _LISTENER_INSTALLED
+
+
+class RuntimeGuards:
+    """Armable runtime contract checker (module docstring). One instance
+    per drive; ``reset()`` between a warmup pass and the guarded pass
+    separates compile noise from steady-state retraces."""
+
+    def __init__(self, transfers: str = "disallow", leaks: bool = True):
+        if transfers not in ("allow", "log", "disallow"):
+            raise ValueError(
+                f"transfers must be allow|log|disallow, got {transfers!r}")
+        self.transfers = transfers
+        self.leaks = bool(leaks)
+        self._compiles = 0
+        self._transfer_counts: Dict[str, int] = {}
+        self._armed_regions = 0
+
+    # -- books -----------------------------------------------------------
+
+    def reset(self) -> None:
+        self._compiles = 0
+        self._transfer_counts = {}
+        self._armed_regions = 0
+
+    def books(self) -> dict:
+        """JSON-able guard books: compile (retrace) events observed while
+        armed, per-site explicit transfer counts, armed-region count."""
+        return {
+            "compiles": int(self._compiles),
+            "transfers": dict(sorted(self._transfer_counts.items())),
+            "armed_regions": int(self._armed_regions),
+        }
+
+    def count(self, site: str) -> None:
+        self._transfer_counts[site] = self._transfer_counts.get(site, 0) + 1
+
+    # -- arming ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def armed(self):
+        """Arm transfer_guard + leak checking + the compile counter for a
+        region (the steady-state device loop)."""
+        import jax
+        _install_listener()
+        self._armed_regions += 1
+        _ACTIVE.add(self)
+        try:
+            with jax.transfer_guard(self.transfers):
+                if self.leaks:
+                    with jax.checking_leaks():
+                        yield self
+                else:
+                    yield self
+        finally:
+            _ACTIVE.discard(self)
+
+    @contextlib.contextmanager
+    def relaxed(self, site: str):
+        """Temporarily re-allow implicit transfers for one named site
+        (e.g. a checkpoint save that numpy-ifies the whole carry). Counted
+        like any other site so the books still show it fired."""
+        import jax
+        self.count(site)
+        with jax.transfer_guard("allow"):
+            yield
+
+
+def guarded_get(guards: Optional[RuntimeGuards], site: str, tree):
+    """Explicit device->host transfer through a named site. With guards
+    None this is exactly ``jax.device_get`` — the hot loops use it
+    unconditionally, so arming changes accounting, never behavior."""
+    import jax
+    if guards is not None:
+        guards.count(site)
+    return jax.device_get(tree)
+
+
+def guarded_put(guards: Optional[RuntimeGuards], site: str, tree):
+    """Explicit host->device transfer through a named site (the serve
+    loop's exec-order/limit operands; implicit numpy operands to a
+    compiled call raise under an armed guard)."""
+    import jax
+    if guards is not None:
+        guards.count(site)
+    return jax.device_put(tree)
+
+
+def armed(guards: Optional[RuntimeGuards]):
+    """``guards.armed()`` or a null context when guards is None."""
+    return guards.armed() if guards is not None else contextlib.nullcontext()
+
+
+def relaxed_site(guards: Optional[RuntimeGuards], site: str):
+    """``guards.relaxed(site)`` or a null context when guards is None."""
+    return (guards.relaxed(site) if guards is not None
+            else contextlib.nullcontext())

@@ -42,6 +42,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from chandy_lamport_tpu.config import ENGINE_KNOBS
+from chandy_lamport_tpu.utils.filelock import locked
 
 # THE memocache schema version: one named registry constant, bumped on
 # any breaking change of the cache line layout or the digest recipe (a
@@ -125,6 +126,52 @@ def job_digest(*, topo_spec, script, fault_key, delay_row, scheduler: str,
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
+def _read_entries(path: str) -> "OrderedDict[str, dict]":
+    """Strict parse of a memo cache file (module docstring format) into
+    an OrderedDict in file order. Raises MemoCacheError on any damage."""
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as exc:
+        raise MemoCacheError(
+            f"memo cache {path}: unreadable ({exc})") from exc
+    for lineno, line in enumerate(raw.splitlines(), start=1):
+        if not line.strip():
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError as exc:
+            raise MemoCacheError(
+                f"memo cache {path}: line {lineno} is not valid JSON "
+                f"(poisoned or truncated write: {exc})") from exc
+        if not isinstance(entry, dict) or not {
+                "schema", "digest", "summary"} <= set(entry):
+            raise MemoCacheError(
+                f"memo cache {path}: line {lineno} is missing the "
+                f"schema/digest/summary keys — not a memo cache entry")
+        if entry["schema"] != MEMOCACHE_SCHEMA_VERSION:
+            raise MemoCacheError(
+                f"memo cache {path}: line {lineno} has schema version "
+                f"{entry['schema']!r}; this build reads only "
+                f"v{MEMOCACHE_SCHEMA_VERSION} (a schema bump changes "
+                f"the digest recipe — stale entries must not be "
+                f"served; delete the file to rebuild it)")
+        digest = entry["digest"]
+        if (not isinstance(digest, str)
+                or len(digest) != _DIGEST_HEX_LEN
+                or any(c not in "0123456789abcdef" for c in digest)):
+            raise MemoCacheError(
+                f"memo cache {path}: line {lineno} digest "
+                f"{digest!r} is not a sha256 hex string")
+        if not isinstance(entry["summary"], dict):
+            raise MemoCacheError(
+                f"memo cache {path}: line {lineno} summary is not an "
+                f"object")
+        out[digest] = entry["summary"]
+    return out
+
+
 class SummaryCache:
     """The persistent content-addressed summary store (module docstring
     format). In-memory dict keyed by digest; ``load`` is strict,
@@ -186,49 +233,14 @@ class SummaryCache:
             self._dirty = True
 
     def _load(self, path: str) -> None:
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                raw = f.read()
-        except OSError as exc:
-            raise MemoCacheError(
-                f"memo cache {path}: unreadable ({exc})") from exc
-        for lineno, line in enumerate(raw.splitlines(), start=1):
-            if not line.strip():
-                continue
-            try:
-                entry = json.loads(line)
-            except ValueError as exc:
-                raise MemoCacheError(
-                    f"memo cache {path}: line {lineno} is not valid JSON "
-                    f"(poisoned or truncated write: {exc})") from exc
-            if not isinstance(entry, dict) or not {
-                    "schema", "digest", "summary"} <= set(entry):
-                raise MemoCacheError(
-                    f"memo cache {path}: line {lineno} is missing the "
-                    f"schema/digest/summary keys — not a memo cache entry")
-            if entry["schema"] != MEMOCACHE_SCHEMA_VERSION:
-                raise MemoCacheError(
-                    f"memo cache {path}: line {lineno} has schema version "
-                    f"{entry['schema']!r}; this build reads only "
-                    f"v{MEMOCACHE_SCHEMA_VERSION} (a schema bump changes "
-                    f"the digest recipe — stale entries must not be "
-                    f"served; delete the file to rebuild it)")
-            digest = entry["digest"]
-            if (not isinstance(digest, str)
-                    or len(digest) != _DIGEST_HEX_LEN
-                    or any(c not in "0123456789abcdef" for c in digest)):
-                raise MemoCacheError(
-                    f"memo cache {path}: line {lineno} digest "
-                    f"{digest!r} is not a sha256 hex string")
-            if not isinstance(entry["summary"], dict):
-                raise MemoCacheError(
-                    f"memo cache {path}: line {lineno} summary is not an "
-                    f"object")
-            # file order is recency order (flush writes LRU-first), so a
-            # straight insert reconstructs the recency chain
-            self._entries[digest] = entry["summary"]
+        with locked(path, shared=True):
+            entries = _read_entries(path)
+        # file order is recency order (flush writes LRU-first), so a
+        # straight insert reconstructs the recency chain
+        for digest, summary in entries.items():
+            self._entries[digest] = summary
             self._entries.move_to_end(digest)
-            self._charge(digest, entry["summary"])
+            self._charge(digest, summary)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -253,22 +265,37 @@ class SummaryCache:
         """Atomically persist every entry (tmp-then-``os.replace``,
         checkpoint.py discipline): a kill at any instant leaves either
         the previous complete file or the new complete file, never a
-        torn one. No-op without a path or pending writes."""
+        torn one. No-op without a path or pending writes.
+
+        Cross-process safe: the whole read-merge-write runs under an
+        exclusive advisory lock (utils/filelock). Entries another
+        process flushed since our load are folded back in as
+        older-than-ours before the rewrite, so concurrent writers to a
+        shared cache path all survive instead of last-writer-wins."""
         if self.path is None or not self._dirty:
             return
         tmp = self.path + ".tmp"
-        try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                for digest, summary in self._entries.items():
-                    f.write(json.dumps(
-                        {"schema": MEMOCACHE_SCHEMA_VERSION,
-                         "digest": digest, "summary": summary},
-                        sort_keys=True) + "\n")
-            os.replace(tmp, self.path)
-            self._dirty = False
-        except BaseException:
+        with locked(self.path):
+            if os.path.exists(self.path):
+                disk = _read_entries(self.path)
+                for digest in reversed(disk):
+                    if digest not in self._entries:
+                        self._entries[digest] = disk[digest]
+                        self._entries.move_to_end(digest, last=False)
+                        self._charge(digest, disk[digest])
+                self._evict()
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for digest, summary in self._entries.items():
+                        f.write(json.dumps(
+                            {"schema": MEMOCACHE_SCHEMA_VERSION,
+                             "digest": digest, "summary": summary},
+                            sort_keys=True) + "\n")
+                os.replace(tmp, self.path)
+                self._dirty = False
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise

@@ -293,6 +293,40 @@ def comm_bytes_model(num_nodes: int, max_snapshots: int, shards: int,
     return out
 
 
+def tick_cost_model(num_nodes: int, num_edges: int, cfg: SimConfig,
+                    batch: int = 1,
+                    queue_engine: str = "gather") -> Dict[str, Any]:
+    """Analytic per-tick cost of the dense engine at a bench shape: the
+    static side of the roofline the bench rows report measured
+    node-ticks/sec against (tools/staticcheck/hlo_cost.py pins the
+    compiled-HLO counterpart per entry arm).
+
+      hbm_bytes_per_tick   2 x instance_footprint_bytes x batch — every
+                           carry leaf is read and written once per tick
+                           (donation keeps it at one live copy, but the
+                           traffic is still read + write).
+      elem_ops_per_tick    the queue-engine head touch: 'gather' reads
+                           and re-scatters one slot per edge ring
+                           (~4 x E element ops: meta + data, read +
+                           write); 'mask' sweeps both full [E, C] ring
+                           planes (~2 x E x C). The C/2 ratio IS the
+                           queue_engine knob's pitch.
+
+    Per-instance state costs are batch-linear by construction (vmap over
+    identical lanes), so both numbers just scale by ``batch``.
+    """
+    per = instance_footprint_bytes(num_nodes, num_edges, cfg)
+    e, c = num_edges, cfg.queue_capacity
+    elem = (2 * e * c if queue_engine == "mask" else 4 * e) * batch
+    return {
+        "instance_bytes": int(per),
+        "hbm_bytes_per_tick": int(2 * per * batch),
+        "elem_ops_per_tick": int(elem),
+        "queue_engine": queue_engine,
+        "batch": int(batch),
+    }
+
+
 def or_reduce(mask) -> jnp.ndarray:
     """Bitwise-OR reduction of an integer bitmask over all axes."""
     mask = jnp.asarray(mask)

@@ -84,6 +84,7 @@ def test_batched_lanes_match_single_instance_fixed_delay():
         assert snap.messages == single_snaps[0].messages
 
 
+@pytest.mark.slow  # conservation is asserted by every tier-1 storm summary
 def test_independent_streams_conserve_tokens_per_lane():
     """UniformJaxDelay gives each lane its own stream: schedules diverge but
     every lane must satisfy the conservation invariant
@@ -191,6 +192,7 @@ def test_auto_layout_rejection_falls_back(batched8_default_ref):
     jax.block_until_ready(final2)
 
 
+@pytest.mark.slow  # per-key eviction also pinned by the serving exec-cache tests
 def test_auto_layout_rejection_is_per_shape_bucket(batched8_default_ref):
     """A rejection evicts ONLY its own shape bucket: another program
     shape compiled earlier keeps its AOT executable (and the state
@@ -317,6 +319,7 @@ def test_relayout_branch_executes_on_mismatched_layouts():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # graphshard equality stays tier-1 via test_graphshard_script
 def test_sharded_run_matches_unsharded():
     assert len(jax.devices()) >= 8, "conftest must force 8 virtual CPU devices"
     topo_spec, events = _fixture("8nodes.top", "8nodes-sequential-snapshots.events")

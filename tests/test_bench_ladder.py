@@ -154,6 +154,12 @@ def test_worker_row_round_trips_queue_engine(engine, capsys):
     assert lc["retried"] == lc["failed"] == lc["stale_markers"] == 0
     assert row["recovery_line_age"] == lc["recovery_line_age_max"] >= 0
     assert "snapshot_timeout" not in row
+    # the analytic roofline rides every row (utils/metrics
+    # .tick_cost_model) keyed to the engine that actually ran
+    cm = row["cost_model"]
+    assert cm["queue_engine"] == engine and cm["batch"] == 2
+    assert cm["hbm_bytes_per_tick"] == 2 * cm["instance_bytes"] * 2
+    assert cm["elem_ops_per_tick"] > 0
 
 
 def test_graphshard_worker_row_round_trips_comm_engine(capsys):
@@ -323,6 +329,10 @@ def test_stream_worker_row_round_trips_memo_books(capsys):
     assert row["memo_speedup"] == pytest.approx(
         row["effective_jobs_per_sec"] / row["effective_jobs_per_sec_off"],
         rel=1e-2)
+    # stream rows carry the same analytic cost model as storm rows
+    cm = row["cost_model"]
+    assert cm["batch"] == 2 and cm["instance_bytes"] > 0
+    assert cm["hbm_bytes_per_tick"] == 2 * cm["instance_bytes"] * 2
 
 
 @pytest.mark.slow

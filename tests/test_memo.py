@@ -196,6 +196,7 @@ def test_kill_and_resume_replans_identically(admit_runner, pool, off_rows,
         admit_runner.memo_cache_path = old_cache
 
 
+@pytest.mark.slow  # memo=full FF also exercised by the staticcheck runtime plane
 def test_fast_forward_skips_livelocked_drain():
     # two nodes, ONE link a->b: a snapshot initiated at the sink can never
     # reach "a", so the drain runs pure +1 ticks to ERR_TICK_LIMIT — the
@@ -356,3 +357,57 @@ def test_memo_full_deep_sweep_with_faults(sched, tmp_path):
         == _strip(r_off.stream_results(s_off))
     summ = r_memo.summarize_stream(s_memo)
     assert summ["coalesced_jobs"] > 0
+
+
+def test_cache_concurrent_flushes_merge_not_clobber(tmp_path):
+    # two caches over ONE path, loaded before either wrote: the second
+    # flush must fold the first writer's entries back in under the file
+    # lock (utils/filelock) instead of rewriting the file from its own
+    # stale view — the cross-process merge semantics, in-process
+    path = str(tmp_path / "shared.jsonl")
+    a = SummaryCache(path)
+    b = SummaryCache(path)
+    a.put("a" * 64, _summ(1))
+    a.flush()
+    b.put("b" * 64, _summ(2))
+    b.flush()
+    merged = SummaryCache(path)
+    assert merged.get("a" * 64) == _summ(1)
+    assert merged.get("b" * 64) == _summ(2)
+    # disk entries fold in as OLDER than the writer's own: under a
+    # 1-entry cap the other process's entry is the eviction victim
+    tight = SummaryCache(path, max_entries=1)
+    assert len(tight) == 1
+
+
+_WRITER = """
+import hashlib, sys
+from chandy_lamport_tpu.utils.memocache import SummaryCache
+path, tag = sys.argv[1], sys.argv[2]
+for i in range(8):
+    c = SummaryCache(path) if __import__('os').path.exists(path) \\
+        else SummaryCache(path)
+    d = hashlib.sha256(f"{tag}-{i}".encode()).hexdigest()
+    c.put(d, {"tag": tag, "i": i})
+    c.flush()
+"""
+
+
+def test_cache_cross_process_writers_all_survive(tmp_path):
+    # the real thing: two processes hammer one cache path with
+    # interleaved load/put/flush cycles; the fcntl lock serializes the
+    # read-merge-write so every digest from both writers survives
+    import hashlib
+
+    path = str(tmp_path / "mp.jsonl")
+    procs = [subprocess.Popen([sys.executable, "-c", _WRITER, path, tag],
+                              cwd=os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))
+             for tag in ("a", "b")]
+    for p in procs:
+        assert p.wait(timeout=120) == 0
+    final = SummaryCache(path)
+    for tag in ("a", "b"):
+        for i in range(8):
+            d = hashlib.sha256(f"{tag}-{i}".encode()).hexdigest()
+            assert final.get(d) == {"tag": tag, "i": i}, (tag, i)

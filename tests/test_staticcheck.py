@@ -256,3 +256,181 @@ def test_jaxpr_fast_plane_clean():
     kept, _allowed = apply_allowlist(vs)
     assert kept == [], [v.to_dict() for v in kept]
     assert len(audited) == 5
+
+
+def test_host_sync_flags_item_float_and_carry_asarray():
+    src = (
+        "import numpy as np\n"
+        "def step(state):\n"
+        "    a = state.time.item()\n"
+        "    b = float(state.time)\n"
+        "    c = np.asarray(state.tokens)\n"
+        "    d = np.asarray(amounts)\n"      # non-carry root: fine
+        "    e = float(\"1.5\")\n"           # literal: fine
+        "    return a, b, c, d, e\n"
+    )
+    vs = ast_lint.check_host_sync({"chandy_lamport_tpu/ops/foo.py": src})
+    assert [v.rule for v in vs] == ["host-sync"] * 3, \
+        [v.to_dict() for v in vs]
+    assert {v.where.split(":")[1] for v in vs} == {"3", "4", "5"}
+    # the same source outside ops/kernels/parallel is not scanned
+    assert ast_lint.check_host_sync(
+        {"chandy_lamport_tpu/utils/foo.py": src}) == []
+
+
+def test_host_sync_allowlists_declared_sites_per_function():
+    src = (
+        "import numpy as np\n"
+        "def pack_jobs(s):\n"            # declared host-side site
+        "    return np.asarray(s.tokens)\n"
+        "def step(s):\n"                 # NOT declared -> flagged
+        "    return np.asarray(s.tokens)\n"
+    )
+    vs = ast_lint.check_host_sync({ast_lint.BATCH_PATH: src})
+    assert len(vs) == 1 and vs[0].where.endswith(":5"), \
+        [v.to_dict() for v in vs]
+    # module-level host code (import-time constants) is out of scope
+    assert ast_lint.check_host_sync({
+        "chandy_lamport_tpu/ops/foo.py":
+            "import numpy as np\nx = np.asarray(state)\n"}) == []
+
+
+def test_cache_lock_requires_locked_replace():
+    bad = (
+        "import os\n"
+        "def flush(path, tmp):\n"
+        "    os.replace(tmp, path)\n"
+    )
+    vs = ast_lint.check_cache_lock({ast_lint.MEMOCACHE_PATH: bad})
+    assert len(vs) == 1 and vs[0].rule == "cache-lock" and \
+        vs[0].where.endswith(":3"), [v.to_dict() for v in vs]
+    good = (
+        "import os\n"
+        "from chandy_lamport_tpu.utils.filelock import locked\n"
+        "def flush(path, tmp):\n"
+        "    with locked(path):\n"
+        "        os.replace(tmp, path)\n"
+    )
+    assert ast_lint.check_cache_lock({ast_lint.SERVING_EXEC_PATH: good}) == []
+    # files outside the shared-cache set are not this rule's business
+    assert ast_lint.check_cache_lock({
+        "chandy_lamport_tpu/utils/checkpoint.py": bad}) == []
+
+
+def test_cost_budget_ceiling_semantics():
+    from tools.staticcheck.hlo_cost import check_against_budget
+
+    # missing budget is itself a violation naming the regenerate knob
+    vs = check_against_budget("arm", {"flops": 1.0}, None)
+    assert len(vs) == 1 and "--budgets-update" in vs[0].detail
+    # floats get FLOAT_TOL headroom; counts are exact ceilings
+    assert check_against_budget(
+        "arm", {"flops": 100.5}, {"flops": 100.0}) == []
+    vs = check_against_budget(
+        "arm", {"flops": 150.0, "collective_count": 2},
+        {"flops": 100.0, "collective_count": 1})
+    details = " | ".join(v.detail for v in vs)
+    assert "flops regressed" in details
+    assert "collective_count regressed" in details
+    # under budget is an improvement, never a violation
+    assert check_against_budget(
+        "arm", {"flops": 10.0, "collective_count": 0},
+        {"flops": 100.0, "collective_count": 1}) == []
+    # a metric the registry predates cannot fail retroactively
+    assert check_against_budget("arm", {"new_metric": 9.0}, {}) == []
+
+
+def test_cost_budget_registry_roundtrip(tmp_path):
+    import jax
+
+    from tools.staticcheck import hlo_cost
+
+    path = str(tmp_path / "budgets.json")
+    entries = {"arm.x": {"flops": 10.0, "collective_count": 1}}
+    hlo_cost.save_budgets(entries, path)
+    loaded, ver = hlo_cost.load_budgets(path)
+    assert loaded == entries and ver == jax.__version__
+    # a foreign-schema file is rejected loudly, never half-read
+    (tmp_path / "bad.json").write_text(
+        json.dumps({"schema": 99, "entries": {}}))
+    with pytest.raises(ValueError, match="schema 99"):
+        hlo_cost.load_budgets(str(tmp_path / "bad.json"))
+    missing, ver = hlo_cost.load_budgets(str(tmp_path / "nope.json"))
+    assert missing == {} and ver is None
+
+
+def test_shipped_cost_budgets_cover_the_matrix():
+    from tools.staticcheck.hlo_cost import load_budgets
+
+    entries, ver = load_budgets()
+    assert len(entries) >= 60, "cost_budgets.json must pin every arm"
+    assert ver, "cost_budgets.json does not record the jax version"
+
+
+def test_cost_plane_names_an_injected_collective():
+    # the deliberate-regression drill: the same computation with one
+    # extra psum must fail its budget with the collective metrics NAMED
+    import jax
+    import jax.numpy as jnp
+
+    from tools.staticcheck.hlo_cost import (
+        check_against_budget,
+        measure_compiled,
+    )
+
+    n = jax.device_count()
+    x = jnp.zeros((n, 8), jnp.float32)
+    clean = measure_compiled(
+        jax.pmap(lambda v: v * 2, axis_name="i").lower(x).compile())
+    regressed = measure_compiled(
+        jax.pmap(lambda v: jax.lax.psum(v * 2, "i"),
+                 axis_name="i").lower(x).compile())
+    assert clean["collective_count"] == 0
+    vs = check_against_budget("scratch.psum", regressed, clean)
+    details = " | ".join(v.detail for v in vs)
+    assert "all_reduce_count regressed" in details, details
+    assert "collective_count regressed" in details, details
+    # and the injected arm passes against its own ceiling
+    assert check_against_budget("scratch.psum", regressed, regressed) == []
+
+
+def test_hlo_op_stats_counts_defs_not_operands():
+    from tools.staticcheck.hlo_cost import hlo_op_stats
+
+    hlo = (
+        "  %ag = f32[8,16]{1,0} all-gather(f32[8,2]{1,0} %p0)\n"
+        "  %ar.1 = f32[8]{0} all-reduce-start(f32[8]{0} %p1)\n"
+        "  %ar.2 = f32[8]{0} all-reduce-done(f32[8]{0} %ar.1)\n"
+        "  %g = s32[4]{0} gather(s32[8]{0} %p2, s32[4]{0} %idx)\n"
+        "  %f = (f32[2]{0}, s32[2]{0}) fusion(f32[8]{0} %p3)\n"
+    )
+    row = hlo_op_stats(hlo)
+    assert row["all_gather_count"] == 1
+    assert row["all_reduce_count"] == 1     # -start counts, -done doesn't
+    assert row["gather_count"] == 1 and row["fusion_count"] == 1
+    assert row["collective_count"] == 2
+    # bytes: all-gather f32[8,16] = 512, all-reduce f32[8] = 32
+    assert row["collective_bytes"] == 512 + 32
+
+
+@pytest.mark.slow
+def test_runtime_sentry_stream_steady_state_is_silent():
+    # zero retraces, zero un-allowlisted transfers per steady-state
+    # stream step after warmup (the tentpole's runtime contract).
+    # slow: `python -m tools.staticcheck --plane runtime` enforces the
+    # same contract across all 9 knob rows out-of-band of the gate.
+    from tools.staticcheck import runtime_sentry
+
+    vs, steps = runtime_sentry._stream_row(
+        "stream.sync.memo=off", "sync", "off")
+    assert vs == [], [v.to_dict() for v in vs]
+    assert steps > 0
+
+
+@pytest.mark.slow
+def test_runtime_sentry_serve_steady_state_is_silent():
+    from tools.staticcheck import runtime_sentry
+
+    vs, steps = runtime_sentry._serve_row("serve.policy=edf", "edf")
+    assert vs == [], [v.to_dict() for v in vs]
+    assert steps > 0

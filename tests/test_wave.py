@@ -88,6 +88,7 @@ def test_wave_vs_cascade_random_storms(case_seed):
     _assert_states_identical(a, b)
 
 
+@pytest.mark.slow  # overflow/fixed-delay/random legs keep wave-vs-cascade tier-1
 def test_wave_vs_cascade_marker_pileup():
     """The shape the wave exists for: a complete digraph where every node
     snapshots in the same phase, so single ticks deliver many markers to

@@ -19,9 +19,16 @@ rung, throughput under ``kernel_engine=xla`` vs ``pallas`` side by side
 with the speedup, so the Pallas claim is read off measured rows instead of
 asserted.
 
+``--cost`` switches to budget mode: print the static per-arm cost rows
+pinned in ``tools/staticcheck/cost_budgets.json`` (modeled FLOPs / HBM
+bytes / collective traffic per compiled engine arm) and cross-check the
+graphshard dense-vs-sparse collective bytes against the analytic
+``utils/metrics.comm_bytes_model`` at the audit fixture's cut.
+
 Usage: python tools/analyze.py [--nodes N] [--batch B] [--scheduler sync]
        python tools/analyze.py --telemetry runs.jsonl
        python tools/analyze.py --bench-rows rows.jsonl
+       python tools/analyze.py --cost
 """
 
 from __future__ import annotations
@@ -142,6 +149,76 @@ def analyze_bench_rows(path: str) -> None:
           "appear under their RESOLVED engine)")
 
 
+def analyze_cost() -> None:
+    """Modeled-cost comparison across the engine knob matrix, read off the
+    pinned ``tools/staticcheck/cost_budgets.json`` rows (no jax, no
+    compile: the budgets ARE the measurements, re-pinned per commit).
+    The graphshard arms get a cross-check: the HLO-measured
+    sparse-over-dense collective-byte ratio is printed next to the
+    analytic ``comm_bytes_model`` ratio recomputed for the audit fixture
+    (erdos_renyi(16, 2.5, seed=11), P=4) — the two models should agree on
+    which engine moves fewer bytes and roughly by how much."""
+    from tools.staticcheck.hlo_cost import BUDGETS_PATH, load_budgets
+
+    entries, jaxver = load_budgets()
+    if not entries:
+        print(f"{BUDGETS_PATH}: no cost budgets — run "
+              f"`python -m tools.staticcheck --plane cost "
+              f"--budgets-update`")
+        return
+    print(f"{BUDGETS_PATH}: {len(entries)} arms (pinned under jax "
+          f"{jaxver})")
+    print(f"  {'arm':<44} {'flops':>10} {'bytes':>10} {'coll':>5} "
+          f"{'collB':>7} {'gather':>6} {'scat':>5} {'fus':>5}")
+    for key in sorted(entries):
+        e = entries[key]
+        print(f"  {key:<44} {e.get('flops', 0):>10.3g} "
+              f"{e.get('bytes_accessed', 0):>10.3g} "
+              f"{int(e.get('collective_count', 0)):>5} "
+              f"{int(e.get('collective_bytes', 0)):>7} "
+              f"{int(e.get('gather_count', 0)):>6} "
+              f"{int(e.get('scatter_count', 0)):>5} "
+              f"{int(e.get('fusion_count', 0)):>5}")
+
+    dense = entries.get("graphshard.dispatch.comm=dense")
+    sparse = entries.get("graphshard.dispatch.comm=sparse")
+    if not (dense and sparse and dense.get("collective_bytes")):
+        print("  (graphshard dense/sparse arms not pinned — no comm "
+              "cross-check)")
+        return
+    hlo_ratio = sparse["collective_bytes"] / dense["collective_bytes"]
+    print(f"\ngraphshard comm cross-check (audit fixture: "
+          f"erdos_renyi(16, 2.5, seed=11), P=4):")
+    print(f"  HLO collective bytes/dispatch: dense "
+          f"{int(dense['collective_bytes'])} B, sparse "
+          f"{int(sparse['collective_bytes'])} B "
+          f"(sparse/dense {hlo_ratio:.3f})")
+    try:
+        from chandy_lamport_tpu.config import SimConfig
+        from chandy_lamport_tpu.core.state import DenseTopology
+        from chandy_lamport_tpu.models.workloads import erdos_renyi
+        from chandy_lamport_tpu.parallel.graphshard import shard_topology
+        from chandy_lamport_tpu.utils.metrics import comm_bytes_model
+    except Exception as exc:  # jax-less environment: table still useful
+        print(f"  (analytic comm_bytes_model unavailable here: {exc})")
+        return
+    topo = DenseTopology(erdos_renyi(16, 2.5, seed=11, tokens=40))
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=32)
+    _, _, bt = shard_topology(topo, 4, incidence=False)
+    m = comm_bytes_model(topo.n, cfg.max_snapshots, 4, bt.halo,
+                         cut_edges=bt.cut_edges, cut_rows=bt.cut_rows)
+    print(f"  comm_bytes_model bytes/tick:   dense "
+          f"{m['dense_bytes_per_tick']} B, sparse "
+          f"{m['sparse_bytes_per_tick']} B "
+          f"(sparse/dense {m['sparse_over_dense']:.3f})")
+    agree = ((hlo_ratio < 1.0) == (m["sparse_over_dense"] < 1.0))
+    print(f"  models {'AGREE' if agree else 'DISAGREE'} on the cheaper "
+          f"engine at this cut (halo {m['halo_rows']} rows, "
+          f"{m['cut_edges']} cut edges); HLO counts whole-dispatch "
+          f"collectives, the analytic model one steady tick — compare "
+          f"ratios, not magnitudes")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=1024)
@@ -157,12 +234,19 @@ def main() -> None:
                    help="print kernel-engine comparison curves from this "
                         "JSONL stream of bench worker rows instead of "
                         "running the kernel cost analysis")
+    p.add_argument("--cost", action="store_true",
+                   help="print the pinned static cost rows per engine arm "
+                        "(tools/staticcheck/cost_budgets.json) plus the "
+                        "graphshard dense-vs-sparse comm cross-check "
+                        "against utils/metrics.comm_bytes_model")
     args = p.parse_args()
 
     if args.telemetry:
         return analyze_telemetry(args.telemetry)
     if args.bench_rows:
         return analyze_bench_rows(args.bench_rows)
+    if args.cost:
+        return analyze_cost()
 
     platform = os.environ.get("CLSIM_PLATFORM")
     import jax

@@ -1,4 +1,4 @@
-"""clsim-audit: two-plane static analyzer for the simulator.
+"""clsim-guard: the simulator's static + runtime analysis planes.
 
 Plane 1 (``jaxpr_audit``) traces every public jitted entry point across the
 engine-knob matrix (``chandy_lamport_tpu.config.ENGINE_KNOBS`` x
@@ -10,9 +10,19 @@ being regenerated.
 
 Plane 2 (``ast_lint``) runs custom AST rules over the package source:
 error-bit registry coverage, checkpoint-format single-sourcing, the
-engine-knob pattern (resolver + CLI flag + bench row per knob), traced-module
-purity (no ``time``/``random``/``np.random``), and explicit ``mode=`` on
-sharded-plane scatters.
+engine-knob pattern (resolver + CLI flag + bench row per knob),
+traced-module purity (no ``time``/``random``/``np.random``), explicit
+``mode=`` on sharded-plane scatters, no host syncs in device-loop
+packages, and locked ``os.replace`` commits of shared cache files.
+
+Plane 3 (``hlo_cost``) backend-compiles the same entry-arm matrix and
+checks a static cost row per arm (FLOPs, HBM bytes, collective
+count/bytes, scatter/gather/fusion counts, peak live buffers) against
+schema-versioned ceilings in ``cost_budgets.json``.
+
+Plane 4 (``runtime_sentry``) actually dispatches tiny shapes per engine
+knob row under ``utils/guards.RuntimeGuards`` and asserts zero retraces
+and zero un-allowlisted transfers per steady-state step after warmup.
 
 Run ``python -m tools.staticcheck`` from the repo root; it writes a JSON
 violations report and exits nonzero on any non-allowlisted violation.

@@ -1,10 +1,16 @@
-"""``python -m tools.staticcheck`` — run both analysis planes, write a JSON
+"""``python -m tools.staticcheck`` — run the analysis planes, write a JSON
 violations report, exit nonzero on any non-allowlisted violation.
 
-The jaxpr plane needs the canonical audit environment (CPU backend, 8 host
-devices, x64) pinned BEFORE jax is imported, so this module sets it up
-first thing — same contract as tests/conftest.py and cli.py, which is what
-keeps the fingerprint registry agreeing between the CLI and the suite.
+Planes: ``jaxpr`` (trace structure), ``ast`` (source lint), ``cost``
+(HLO cost budgets), ``runtime`` (the guard sentry, actually dispatches
+tiny shapes). ``--plane all`` (the default) runs everything; ``--plane
+both`` keeps the historical jaxpr+ast pairing for quick structural runs.
+
+The jax-touching planes need the canonical audit environment (CPU
+backend, 8 host devices, x64) pinned BEFORE jax is imported, so this
+module sets it up first thing — same contract as tests/conftest.py and
+cli.py, which is what keeps the fingerprint registry agreeing between
+the CLI and the suite.
 """
 
 from __future__ import annotations
@@ -27,16 +33,23 @@ def main(argv=None) -> int:
         build_report,
         report_to_json,
     )
-    from tools.staticcheck import ast_lint, jaxpr_audit
+    from tools.staticcheck import ast_lint, hlo_cost, jaxpr_audit, \
+        runtime_sentry
 
     ap = argparse.ArgumentParser(
         prog="tools.staticcheck",
-        description="clsim-audit: jaxpr trace auditor + AST lint")
-    ap.add_argument("--plane", choices=("jaxpr", "ast", "both"),
-                    default="both")
+        description="clsim-audit: jaxpr/AST/cost/runtime analysis planes")
+    ap.add_argument("--plane",
+                    choices=("jaxpr", "ast", "cost", "runtime", "both",
+                             "all"),
+                    default="all",
+                    help="'both' = jaxpr+ast (the historical pair); "
+                         "'all' adds the cost-budget and runtime-sentry "
+                         "planes (default)")
     ap.add_argument("--fast", action="store_true",
-                    help="jaxpr plane: one arm per engine axis instead of "
-                         "the full knob matrix")
+                    help="jaxpr/cost planes: one arm per engine axis "
+                         "instead of the full knob matrix; runtime plane: "
+                         "one row per loop family")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="write the report here (default: stdout only)")
     ap.add_argument("--fingerprints-update", action="store_true",
@@ -44,20 +57,36 @@ def main(argv=None) -> int:
                          "entry traced in this run")
     ap.add_argument("--no-fingerprints", action="store_true",
                     help="skip the fingerprint registry check")
+    ap.add_argument("--budgets-update", action="store_true",
+                    help="re-pin cost_budgets.json for every arm measured "
+                         "in this run")
+    ap.add_argument("--no-budgets", action="store_true",
+                    help="measure the cost plane but skip the budget "
+                         "comparison")
     args = ap.parse_args(argv)
 
-    # only the jaxpr plane needs jax (and the pinned audit env) at all —
-    # a lint-only run must stay import-light and never mutate XLA env vars
-    if args.plane in ("jaxpr", "both"):
+    planes = {
+        "jaxpr": ("jaxpr",),
+        "ast": ("ast",),
+        "cost": ("cost",),
+        "runtime": ("runtime",),
+        "both": ("jaxpr", "ast"),
+        "all": ("jaxpr", "ast", "cost", "runtime"),
+    }[args.plane]
+
+    # only the jax-touching planes need jax (and the pinned audit env) at
+    # all — a lint-only run must stay import-light and never mutate XLA
+    # env vars
+    if set(planes) & {"jaxpr", "cost", "runtime"}:
         jaxpr_audit.ensure_env()
 
     violations = []
     audited = []
     notes = []
     mode = "fast" if args.fast else "full"
-    if args.plane in ("ast", "both"):
+    if "ast" in planes:
         violations.extend(ast_lint.lint_tree(root))
-    if args.plane in ("jaxpr", "both"):
+    if "jaxpr" in planes:
         vs, keys, _fps = jaxpr_audit.audit(
             mode,
             check_fingerprints=not args.no_fingerprints,
@@ -66,6 +95,19 @@ def main(argv=None) -> int:
         audited.extend(keys)
         if jaxpr_audit._LAST_REGISTRY_NOTE:
             notes.append(jaxpr_audit._LAST_REGISTRY_NOTE)
+    if "cost" in planes:
+        vs, keys, _rows = hlo_cost.audit(
+            mode,
+            check_budgets=not args.no_budgets,
+            update_budgets=args.budgets_update)
+        violations.extend(vs)
+        audited.extend(f"cost:{k}" for k in keys)
+        if hlo_cost._LAST_BUDGET_NOTE:
+            notes.append(hlo_cost._LAST_BUDGET_NOTE)
+    if "runtime" in planes:
+        vs, keys, _steps = runtime_sentry.audit(mode)
+        violations.extend(vs)
+        audited.extend(f"runtime:{k}" for k in keys)
 
     kept, allowed = apply_allowlist(violations)
     report = build_report(kept, allowed, entries_audited=audited, mode=mode,

@@ -57,13 +57,28 @@ Rules (ids are stable; the README rule table documents them):
                       in serving/server.py; every ``"serve_schema":``
                       stamp in the package references the Name, and no
                       other module re-assigns the constant.
+  host-sync           ``.item()``, ``float(<non-constant>)`` and
+                      ``np.asarray(<device carry>)`` are banned inside
+                      function bodies in ops/, kernels/ and parallel/ —
+                      each is an implicit device->host sync that stalls
+                      the dispatch pipeline and trips the runtime
+                      sentry's transfer guard. Intentional harvest/
+                      pack/termination functions are declared per-file
+                      in HOST_SYNC_SITES; everything else must route
+                      through utils/guards.guarded_get (explicit,
+                      counted, guard-legal).
+  cache-lock          every ``os.replace`` commit of a shared cache file
+                      (utils/memocache.py, serving/executables.py) must
+                      sit lexically inside a ``with locked(...)`` block
+                      (utils/filelock) — an unlocked rename races
+                      concurrent writers back to last-writer-wins.
 """
 
 from __future__ import annotations
 
 import ast
 import os
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
 
 from tools.staticcheck import Violation
 
@@ -74,6 +89,8 @@ CLI_PATH = "chandy_lamport_tpu/cli.py"
 BENCH_PATH = "chandy_lamport_tpu/bench.py"
 MEMOCACHE_PATH = "chandy_lamport_tpu/utils/memocache.py"
 SERVING_SERVER_PATH = "chandy_lamport_tpu/serving/server.py"
+SERVING_EXEC_PATH = "chandy_lamport_tpu/serving/executables.py"
+BATCH_PATH = "chandy_lamport_tpu/parallel/batch.py"
 
 # the memo opt-in ladder; "off" first — the table order IS the contract
 # (off is the default and the bit-identity baseline)
@@ -811,6 +828,145 @@ def check_serve_schema(sources: Dict[str, str]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# host-sync
+
+# device-loop packages: an implicit device->host sync here stalls the
+# dispatch pipeline and (in an armed loop) trips the runtime sentry's
+# transfer guard at dispatch time — this rule catches it at review time
+HOST_SYNC_DIRS = ("chandy_lamport_tpu/ops/", "chandy_lamport_tpu/kernels/",
+                  "chandy_lamport_tpu/parallel/")
+
+# intentional host-side sites, declared per file + function name (BY
+# SITE, mirroring runtime_sentry's per-row allowlists, never globally):
+# pack_jobs/_job_digests run on host ScriptOps arrays before the carry
+# upload; summarize harvests a state the caller already device_get
+HOST_SYNC_SITES: Dict[str, FrozenSet[str]] = {
+    BATCH_PATH: frozenset({"pack_jobs", "_job_digests", "summarize"}),
+}
+
+# the names the engine gives the device carry in loop bodies; asarray
+# on anything rooted at one of these is a d2h of live device state
+_HOST_SYNC_CARRIES = frozenset({"s", "state", "stream"})
+
+
+def _root_name(expr: ast.expr) -> Optional[str]:
+    """The base Name of an attribute/subscript chain (``s.q.tokens[i]``
+    -> ``s``), or None."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _host_sync_call(node: ast.Call) -> Optional[str]:
+    """Classify one Call as a banned host sync, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "item" and \
+            not node.args and not node.keywords:
+        return ".item() forces a device->host sync of a live array"
+    if isinstance(fn, ast.Name) and fn.id == "float" and node.args and \
+            not isinstance(node.args[0], ast.Constant):
+        return "float(...) on a non-literal blocks on a d2h readback"
+    if isinstance(fn, ast.Attribute) and fn.attr == "asarray" and \
+            isinstance(fn.value, ast.Name) and \
+            fn.value.id in ("np", "numpy") and node.args:
+        root = _root_name(node.args[0])
+        if root in _HOST_SYNC_CARRIES:
+            return (f"np.asarray({root}...) copies the device carry "
+                    f"back to host")
+    return None
+
+
+def check_host_sync(sources: Dict[str, str]) -> List[Violation]:
+    """No implicit device->host syncs in function bodies under ops/,
+    kernels/, parallel/ (module docstring). Intentional sites go in
+    HOST_SYNC_SITES; loop-side reads route through
+    utils/guards.guarded_get — explicit, counted, and legal under the
+    armed transfer guard."""
+    out: List[Violation] = []
+
+    def visit(path: str, node: ast.AST, in_fn: bool,
+              allowed: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in allowed:
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(path, child, True, allowed)
+            return
+        if in_fn and isinstance(node, ast.Call):
+            why = _host_sync_call(node)
+            if why is not None:
+                out.append(Violation(
+                    "host-sync", f"{path}:{node.lineno}",
+                    f"{why} — use utils/guards.guarded_get at a named "
+                    f"site, or declare the function in ast_lint."
+                    f"HOST_SYNC_SITES if the sync is intentionally "
+                    f"host-side"))
+        for child in ast.iter_child_nodes(node):
+            visit(path, child, in_fn, allowed)
+
+    for path in sorted(sources):
+        if not path.startswith(HOST_SYNC_DIRS):
+            continue
+        try:
+            tree = ast.parse(sources[path], filename=path)
+        except SyntaxError:
+            continue
+        visit(path, tree, False, HOST_SYNC_SITES.get(path, frozenset()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cache-lock
+
+# files whose on-disk artifacts are shared across processes (the stream
+# SummaryCache journal; the serve executable cache) — their os.replace
+# commits must hold the utils/filelock lock or concurrent writers race
+# back to last-writer-wins
+CACHE_LOCK_PATHS = (MEMOCACHE_PATH, SERVING_EXEC_PATH)
+
+
+def _is_locked_ctx(expr: ast.expr) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    return (isinstance(fn, ast.Name) and fn.id == "locked") or \
+        (isinstance(fn, ast.Attribute) and fn.attr == "locked")
+
+
+def check_cache_lock(sources: Dict[str, str]) -> List[Violation]:
+    """Every ``os.replace`` in a shared-cache module sits lexically
+    inside a ``with locked(...)`` block (module docstring). The lexical
+    check is deliberately strict: passing fd ownership around would hide
+    the lock scope from review."""
+    out: List[Violation] = []
+
+    def visit(path: str, node: ast.AST, locked_ctx: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked_ctx = locked_ctx or any(
+                _is_locked_ctx(item.context_expr) for item in node.items)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "replace" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "os":
+            if not locked_ctx:
+                out.append(Violation(
+                    "cache-lock", f"{path}:{node.lineno}",
+                    "os.replace of a shared cache file outside a `with "
+                    "locked(...)` block (utils/filelock) — concurrent "
+                    "writers race the rename to last-writer-wins"))
+        for child in ast.iter_child_nodes(node):
+            visit(path, child, locked_ctx)
+
+    for path in CACHE_LOCK_PATHS:
+        tree = _parse(sources, path)
+        if tree is None:
+            continue
+        visit(path, tree, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 ALL_RULES = (
@@ -824,6 +980,8 @@ ALL_RULES = (
     check_memo_schema,
     check_serve_knob,
     check_serve_schema,
+    check_host_sync,
+    check_cache_lock,
 )
 
 

@@ -1,0 +1,298 @@
+"""Plane 3: static HLO cost budgets across the entry-arm matrix.
+
+The jaxpr plane (jaxpr_audit.py) audits trace STRUCTURE; this plane
+audits trace COST. Every registered entry arm — the same ~69-arm
+`{queue,comm,kernel,memo,serve_policy}` knob matrix iter_entry_builders
+yields — is lowered and backend-compiled, and the optimized HLO module
+is walked into a static cost row per arm:
+
+  flops / bytes_accessed   XLA's own ``Compiled.cost_analysis()`` —
+                           modeled FLOPs and HBM bytes moved per call.
+  argument/output/temp     ``Compiled.memory_analysis()`` buffer sizes;
+  peak_buffer_bytes        arg + out + temp − aliased, the static
+                           peak-live estimate (donation shows up here
+                           as alias credit).
+  collective_count/bytes   per-op counts of all-reduce / all-gather /
+  + per-collective counts  reduce-scatter / all-to-all /
+                           collective-permute defs in the optimized
+                           module, plus the summed byte size of their
+                           result shapes — the cross-shard traffic the
+                           comm_engine knob exists to shrink.
+  scatter/gather/fusion    op-shape counts for the queue engines' core
+                           primitives and XLA's fusion granularity.
+
+Rows are checked against ``cost_budgets.json`` — same schema-versioned,
+recorded-jax-version, regenerate-in-the-same-commit discipline as the
+trace fingerprints (jaxpr_audit.load_registry). Budgets are CEILINGS:
+an arm may come in under budget (that is an improvement — regenerate to
+re-pin), but a PR that adds an all-gather to the tick or regrows the
+[E,C] round-trip exceeds its recorded ceiling and fails
+``python -m tools.staticcheck`` with a named metric diff. Floats get
+FLOAT_TOL headroom (cost_analysis models wobble slightly across
+rebuilds of the same program); counts are exact ceilings.
+
+FS_GPlib (PAPERS.md) budgets propagation kernels by modeled bytes and
+FLOPs rather than wall clock; this plane is that discipline applied to
+every compiled surface of the engine, on every PR, with no hardware in
+the loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+from tools.staticcheck import Violation
+from tools.staticcheck.jaxpr_audit import (
+    Entry,
+    ensure_env,
+    iter_entry_builders,
+)
+
+BUDGETS_PATH = os.path.join(os.path.dirname(__file__), "cost_budgets.json")
+
+BUDGET_SCHEMA = 1
+
+# relative headroom for float metrics (flops / bytes): XLA's analytical
+# model is deterministic for a fixed program, but equivalent rebuilds
+# (e.g. a refactor that renames a fusion) can wobble it at the margin
+FLOAT_TOL = 0.01
+
+# one mutually-exclusive HLO opcode per collective family ("-start"
+# suffixed async forms count as the op; "-done" halves do not define a
+# new transfer)
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "token": 0,
+}
+
+# an HLO def site: `%name = <shape> opcode(`; the shape is a single
+# `dtype[dims]{layout}` or a tuple of them
+_DEF_RE = re.compile(
+    r"=\s+(?P<shape>\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)"
+    r"\s+(?P<op>[a-z][a-z0-9-]*)\(")
+
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+
+# budget metrics: floats get FLOAT_TOL headroom, counts are exact
+FLOAT_METRICS = ("flops", "bytes_accessed", "argument_bytes",
+                 "output_bytes", "temp_bytes", "peak_buffer_bytes",
+                 "collective_bytes")
+
+
+def _shape_bytes(shape: str) -> int:
+    """Byte size of an HLO result shape string (tuples sum elementwise)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def hlo_op_stats(hlo_text: str) -> Dict[str, float]:
+    """Walk an optimized HLO module's def sites into the op-count half of
+    the cost row (module docstring). Fusion-interior defs count too —
+    a gather inside a fused computation is still a gather the backend
+    executes."""
+    counts: Dict[str, int] = {op: 0 for op in COLLECTIVE_OPS}
+    counts["scatter"] = 0
+    counts["gather"] = 0
+    counts["fusion"] = 0
+    collective_bytes = 0
+    for m in _DEF_RE.finditer(hlo_text):
+        op = m.group("op")
+        if op.endswith("-start"):
+            op = op[:-len("-start")]
+        elif op.endswith("-done"):
+            continue
+        if op in COLLECTIVE_OPS:
+            counts[op] += 1
+            collective_bytes += _shape_bytes(m.group("shape"))
+        elif op in ("scatter", "gather", "fusion"):
+            counts[op] += 1
+    row: Dict[str, float] = {
+        f"{op.replace('-', '_')}_count": counts[op] for op in COLLECTIVE_OPS}
+    row["scatter_count"] = counts["scatter"]
+    row["gather_count"] = counts["gather"]
+    row["fusion_count"] = counts["fusion"]
+    row["collective_count"] = sum(counts[op] for op in COLLECTIVE_OPS)
+    row["collective_bytes"] = collective_bytes
+    return row
+
+
+def measure_compiled(compiled) -> Dict[str, float]:
+    """The static cost row of one backend-compiled executable."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jaxlibs: one dict per device
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    row: Dict[str, float] = {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        arg = int(getattr(mem, "argument_size_in_bytes", 0))
+        out = int(getattr(mem, "output_size_in_bytes", 0))
+        tmp = int(getattr(mem, "temp_size_in_bytes", 0))
+        alias = int(getattr(mem, "alias_size_in_bytes", 0))
+        row.update(argument_bytes=arg, output_bytes=out, temp_bytes=tmp,
+                   peak_buffer_bytes=max(arg + out + tmp - alias, 0))
+    row.update(hlo_op_stats(compiled.as_text()))
+    return row
+
+
+def measure_entry(entry: Entry) -> Dict[str, float]:
+    """Lower + compile one audit entry and measure it. Prefers the
+    user-facing jitted callable (donation aliasing is part of the peak-
+    buffer story); bare fns are jitted here."""
+    import jax
+    fn = entry.jit_fn
+    if fn is None:
+        fn = entry.fn if hasattr(entry.fn, "lower") else jax.jit(entry.fn)
+    return measure_compiled(fn.lower(*entry.args).compile())
+
+
+# ---------------------------------------------------------------------------
+# budget registry (fingerprints.json discipline: schema + jax stamped,
+# regenerated in the same commit as an intentional cost change)
+
+# set by audit(): human-readable note when the registry comparison was
+# skipped (version mismatch); __main__ surfaces it in the report
+_LAST_BUDGET_NOTE: Optional[str] = None
+
+
+def load_budgets(path: Optional[str] = None):
+    """Returns (entries, recorded_jax_version)."""
+    path = path or BUDGETS_PATH
+    if not os.path.exists(path):
+        return {}, None
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(
+            f"cost budgets {path}: not a schema-{BUDGET_SCHEMA} registry")
+    if data.get("schema") != BUDGET_SCHEMA:
+        raise ValueError(
+            f"cost budgets {path}: schema {data.get('schema')!r}; this "
+            f"build reads only v{BUDGET_SCHEMA} — regenerate with "
+            f"--budgets-update")
+    return dict(data["entries"]), data.get("jax")
+
+
+def save_budgets(entries: Dict[str, Dict[str, float]],
+                 path: Optional[str] = None) -> None:
+    import jax
+    path = path or BUDGETS_PATH
+    payload = {
+        "schema": BUDGET_SCHEMA,
+        "jax": jax.__version__,
+        "entries": {k: dict(sorted(v.items()))
+                    for k, v in sorted(entries.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def check_against_budget(key: str, row: Dict[str, float],
+                         budget: Optional[Dict[str, float]]
+                         ) -> List[Violation]:
+    """Ceiling comparison of a measured cost row against its recorded
+    budget (module docstring semantics). A missing budget is itself a
+    violation: every arm must be pinned or the plane is blind to it."""
+    if budget is None:
+        return [Violation(
+            "cost-budget", key,
+            "no recorded cost budget — run "
+            "`python -m tools.staticcheck --budgets-update`")]
+    out: List[Violation] = []
+    for metric in sorted(row):
+        have = row[metric]
+        want = budget.get(metric)
+        if want is None:
+            # a metric this build measures but the registry predates:
+            # only a regenerate can pin it; don't fail retroactively
+            continue
+        if metric in FLOAT_METRICS:
+            ceiling = float(want) * (1.0 + FLOAT_TOL)
+            over = float(have) > ceiling and float(have) - float(want) > 1.0
+        else:
+            over = int(have) > int(want)
+        if over:
+            pct = (100.0 * (float(have) - float(want)) / float(want)
+                   if float(want) else float("inf"))
+            out.append(Violation(
+                "cost-budget", key,
+                f"{metric} regressed: measured {have:g} > budget "
+                f"{want:g} (+{pct:.1f}%) — an intentional cost change "
+                f"must regenerate cost_budgets.json in the same commit"))
+    return out
+
+
+def audit(mode: str = "full", *, check_budgets: bool = True,
+          update_budgets: bool = False,
+          keys: Optional[Sequence[str]] = None):
+    """Run the cost plane. Returns (violations, audited_keys, fresh_rows).
+
+    Mirrors jaxpr_audit.audit: fast mode measures the 5-arm tier-1
+    subset, full the whole matrix; ``update_budgets`` re-pins measured
+    arms instead of comparing; a registry recorded under a different jax
+    version is skipped with a note (XLA's cost model and fusion
+    decisions legitimately move across toolchains)."""
+    global _LAST_BUDGET_NOTE
+    ensure_env()
+    _LAST_BUDGET_NOTE = None
+    registry = None
+    if check_budgets and not update_budgets:
+        import jax
+        entries, recorded_jax = load_budgets()
+        if recorded_jax is not None and recorded_jax != jax.__version__:
+            _LAST_BUDGET_NOTE = (
+                f"cost budgets were generated under jax {recorded_jax} "
+                f"but this run is jax {jax.__version__}; comparison "
+                f"skipped — run --budgets-update to re-pin")
+        else:
+            registry = entries
+    violations: List[Violation] = []
+    audited: List[str] = []
+    fresh: Dict[str, Dict[str, float]] = {}
+    for key, build in iter_entry_builders(mode):
+        if keys is not None and key not in keys:
+            continue
+        try:
+            entry = build()
+            row = measure_entry(entry)
+        except Exception as exc:
+            violations.append(Violation(
+                "entry-build", key,
+                f"could not lower/compile the costed entry: "
+                f"{type(exc).__name__}: {exc}"))
+            continue
+        if registry is not None:
+            violations.extend(
+                check_against_budget(key, row, registry.get(key)))
+        audited.append(key)
+        fresh[key] = row
+    if update_budgets:
+        merged, _ = load_budgets()
+        merged.update(fresh)
+        save_budgets(merged)
+    return violations, audited, fresh

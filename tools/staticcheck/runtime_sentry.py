@@ -1,0 +1,205 @@
+"""Plane ``runtime``: the runtime contract sentry over the knob matrix.
+
+Static planes can't see dispatch-time behavior: a retrace that only
+happens when the serve queue reorders, a host sync snuck into the
+stream loop, a numpy operand silently uploaded every step. This plane
+RUNS the engine — tiny shapes, one row per engine-knob combination —
+twice per row: a warmup pass that compiles and caches every jitted
+step, then a steady-state pass under utils/guards.RuntimeGuards
+(``jax.transfer_guard("disallow")`` + ``jax.checking_leaks`` + the
+compile-event counter), asserting the vectorized-MCMC discipline the
+loops claim (PAPERS.md): ZERO compiles after warmup and ZERO transfers
+outside the named sites below.
+
+Allowlisting is BY SITE, not global: each row declares exactly which
+named transfer sites (utils/guards guarded_get/guarded_put/relaxed
+call sites) may fire in steady state. A new sync point in a loop shows
+up as an un-allowlisted site name (or, if it bypasses the site helpers
+entirely, as an XlaRuntimeError from the transfer guard) and fails
+``python -m tools.staticcheck --plane runtime`` with the row and site
+named.
+
+Rows (full mode): stream {sync,exact} x memo {off,admit,full} + serve
+{edf,fifo} + one graphshard storm arm. Fast mode keeps one row per
+loop family for tier-1.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.staticcheck import Violation
+from tools.staticcheck.jaxpr_audit import ensure_env
+
+# the per-row transfer-site allowlists — THE declarative contract this
+# plane enforces. Sites are defined at the guarded_get/guarded_put/
+# relaxed call sites in parallel/batch.py, serving/server.py.
+STREAM_SITES: FrozenSet[str] = frozenset({
+    "stream-carry-upload",         # one bulk h2d per run (init carry)
+    "stream-termination-scalars",  # one d2h of (jobs_done, steps)/step
+    "memo-fastforward",            # memo=full: host signature watch
+})
+SERVE_SITES: FrozenSet[str] = frozenset({
+    "serve-carry-upload",          # one bulk h2d per run (init carry)
+    "serve-admission-order",       # exec-order rewrite, one put/step
+    "serve-admission-limit",       # admissible-prefix scalar, one/step
+    "serve-progress-scalars",      # the one sync point per step
+})
+GRAPHSHARD_SITES: FrozenSet[str] = frozenset()
+
+
+def _topo():
+    from chandy_lamport_tpu.models.workloads import ring_topology
+    return ring_topology(8, tokens=16)
+
+
+def _runner(scheduler: str, memo: str, guards):
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    return BatchedRunner(
+        _topo(), SimConfig.for_workload(snapshots=2, max_recorded=32),
+        make_fast_delay("hash", 7), 2, scheduler=scheduler, megatick=2,
+        memo=memo, guards=guards)
+
+
+def _check_books(key: str, books: dict, allowed: FrozenSet[str],
+                 steps: int) -> List[Violation]:
+    out: List[Violation] = []
+    if books["compiles"]:
+        out.append(Violation(
+            "runtime-retrace", key,
+            f"{books['compiles']} compile event(s) in the steady-state "
+            f"pass ({steps} step(s)) after warmup — the step retraced "
+            f"(new shapes, new static args, or a rebuilt jit)"))
+    bad = sorted(set(books["transfers"]) - allowed)
+    if bad:
+        out.append(Violation(
+            "runtime-transfer", key,
+            f"un-allowlisted transfer site(s) fired in steady state: "
+            f"{', '.join(bad)} — add the site to runtime_sentry's row "
+            f"allowlist only if the sync is intentional"))
+    return out
+
+
+def _stream_row(key: str, scheduler: str, memo: str) -> Tuple[
+        List[Violation], int]:
+    from chandy_lamport_tpu.models.workloads import stream_jobs
+    from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+    guards = RuntimeGuards()
+    runner = _runner(scheduler, memo, guards)
+    jobs = stream_jobs(_topo(), 6, seed=5, base_phases=2, max_phases=4,
+                       dup_rate=0.5 if memo != "off" else 0.0)
+    pool = runner.pack_jobs(jobs,
+                            content_keys=True if memo != "off" else None)
+    runner.run_stream(pool, stretch=2, drain_chunk=8)      # warmup
+    guards.reset()
+    _, stream = runner.run_stream(pool, stretch=2, drain_chunk=8)
+    import jax
+    steps = int(jax.device_get(stream.steps))
+    return _check_books(key, guards.books(), STREAM_SITES, steps), steps
+
+
+def _serve_row(key: str, policy: str) -> Tuple[List[Violation], int]:
+    from chandy_lamport_tpu.models.workloads import serve_workload
+    from chandy_lamport_tpu.serving.executables import ExecutableCache
+    from chandy_lamport_tpu.serving.server import serve_run
+    from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+    guards = RuntimeGuards()
+    runner = _runner("sync", "off", guards)
+    reqs = serve_workload(_topo(), 6, seed=17, rate=2.0, tenants=2,
+                          max_phases=6)
+    cache = ExecutableCache(None)  # shared: second run hits memory plane
+    serve_run(runner, reqs, policy=policy, stretch=2, drain_chunk=8,
+              exec_cache=cache)                            # warmup
+    guards.reset()
+    _, _, report = serve_run(runner, reqs, policy=policy, stretch=2,
+                             drain_chunk=8, exec_cache=cache)
+    steps = int(report["steps"])
+    vs = _check_books(key, guards.books(), SERVE_SITES, steps)
+    if report["warmup_source"] != "memory":
+        vs.append(Violation(
+            "runtime-retrace", key,
+            f"steady-state serve did not reuse the warm executable "
+            f"(warmup_source={report['warmup_source']!r})"))
+    return vs, steps
+
+
+def _graphshard_row(key: str) -> Tuple[List[Violation], int]:
+    import numpy as np
+    from jax.sharding import Mesh
+    import jax
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.workloads import (
+        erdos_renyi, staggered_snapshots, storm_program)
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+    guards = RuntimeGuards()
+    topo = erdos_renyi(16, 2.5, seed=11, tokens=40)
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("graph",))
+    gs = GraphShardedRunner(
+        topo, SimConfig.for_workload(snapshots=2, max_recorded=32), mesh,
+        axis="graph", fixed_delay=2, guards=guards)
+    prog = storm_program(gs.topo, phases=2, amount=1,
+                         snapshot_phases=staggered_snapshots(gs.topo, 1))
+    gs.run_storm(gs.init_state(), prog.amounts, prog.snap)  # warmup
+    guards.reset()
+    gs.run_storm(gs.init_state(), prog.amounts, prog.snap)
+    return _check_books(key, guards.books(), GRAPHSHARD_SITES, 1), 1
+
+
+def iter_rows(mode: str = "full"):
+    """Yield (key, thunk) per sentry row (jaxpr_audit builder idiom)."""
+    if mode == "fast":
+        rows = [
+            ("stream.sync.memo=off",
+             lambda: _stream_row("stream.sync.memo=off", "sync", "off")),
+            ("stream.sync.memo=full",
+             lambda: _stream_row("stream.sync.memo=full", "sync", "full")),
+            ("serve.policy=edf",
+             lambda: _serve_row("serve.policy=edf", "edf")),
+        ]
+    else:
+        rows = [
+            (f"stream.{sch}.memo={memo}",
+             lambda sch=sch, memo=memo: _stream_row(
+                 f"stream.{sch}.memo={memo}", sch, memo))
+            for sch in ("sync", "exact")
+            for memo in ("off", "admit", "full")
+        ] + [
+            (f"serve.policy={pol}",
+             lambda pol=pol: _serve_row(f"serve.policy={pol}", pol))
+            for pol in ("edf", "fifo")
+        ] + [
+            ("graphshard.storm",
+             lambda: _graphshard_row("graphshard.storm")),
+        ]
+    return rows
+
+
+def audit(mode: str = "full", *, keys: Optional[Sequence[str]] = None):
+    """Run the sentry. Returns (violations, audited_keys, steps_by_key)."""
+    ensure_env()
+    violations: List[Violation] = []
+    audited: List[str] = []
+    steps_by_key: Dict[str, int] = {}
+    for key, run in iter_rows(mode):
+        if keys is not None and key not in keys:
+            continue
+        try:
+            vs, steps = run()
+        except Exception as exc:
+            violations.append(Violation(
+                "runtime-transfer", key,
+                f"guarded steady-state pass raised "
+                f"{type(exc).__name__}: {exc} — an implicit transfer or "
+                f"tracer leak inside the armed loop"))
+            audited.append(key)
+            continue
+        violations.extend(vs)
+        audited.append(key)
+        steps_by_key[key] = steps
+    return violations, audited, steps_by_key
