@@ -168,6 +168,25 @@ def _parser() -> argparse.ArgumentParser:
                         "(kernels.resolve_kernel_engine). Bit-identical "
                         "results; the JSON row's kernel_engine field "
                         "records the RESOLVED engine")
+    p.add_argument("--fused-tick", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="one-kernel megatick (kernels/megatick.py): 'on' = "
+                        "run every exact-path multi-tick/drain/flush loop "
+                        "as ONE Pallas kernel scanning K full ticks with "
+                        "the whole state VMEM-resident (requires "
+                        "--kernel-engine pallas and --megatick > 1; raises "
+                        "naming the first unmet requirement otherwise), "
+                        "'off' = the split per-stage kernels, 'auto' "
+                        "(default) = fuse exactly when the requirements "
+                        "hold and the working set fits the VMEM budget "
+                        "(megatick.resolve_fused_tick). Bit-identical "
+                        "results; the JSON row's fused_tick field records "
+                        "the RESOLUTION ('on'/'off')")
+    p.add_argument("--fused-block-edges", type=int, default=0,
+                   help="fault-plane DMA block width for the fused "
+                        "megatick's double-buffered HBM->VMEM edge-mask "
+                        "stream (kernels/megatick.plan_edge_blocks); 0 = "
+                        "the default 512-edge blocks")
     p.add_argument("--comm-engine", choices=["auto", "dense", "sparse"],
                    default="auto",
                    help="--graphshard only: cross-shard traffic engine "
@@ -522,7 +541,9 @@ def run_worker(args) -> int:
                                auto_layouts=args.layouts == "auto",
                                megatick=args.megatick,
                                queue_engine=args.queue_engine,
-                               kernel_engine=args.kernel_engine, trace=trace)
+                               kernel_engine=args.kernel_engine, trace=trace,
+                               fused_tick=args.fused_tick,
+                               fused_block_edges=args.fused_block_edges)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -643,7 +664,9 @@ def run_worker(args) -> int:
                              auto_layouts=args.layouts == "auto",
                              megatick=args.megatick,
                              queue_engine=args.queue_engine,
-                             kernel_engine=args.kernel_engine)
+                             kernel_engine=args.kernel_engine,
+                             fused_tick=args.fused_tick,
+                             fused_block_edges=args.fused_block_edges)
         fmtb = base.prepare_storm(prog)
         fb = base.run_storm(base.init_batch_device(formats=fmtb), prog)
         jax.block_until_ready(fb)
@@ -681,6 +704,7 @@ def run_worker(args) -> int:
         **({"megatick": args.megatick} if args.scheduler == "exact" else {}),
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
+        "fused_tick": runner.fused,
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -809,7 +833,9 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                            exact_impl=args.exact_impl,
                            megatick=args.megatick,
                            queue_engine=args.queue_engine,
-                           kernel_engine=args.kernel_engine, trace=trace)
+                           kernel_engine=args.kernel_engine, trace=trace,
+                           fused_tick=args.fused_tick,
+                           fused_block_edges=args.fused_block_edges)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
                        tail_alpha=1.1, max_phases=max(args.phases, 8),
@@ -876,6 +902,7 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                       else f"exact/{args.exact_impl}"),
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
+        "fused_tick": runner.fused,
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -914,6 +941,8 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                                     megatick=args.megatick,
                                     queue_engine=args.queue_engine,
                                     kernel_engine=args.kernel_engine,
+                                    fused_tick=args.fused_tick,
+                                    fused_block_edges=args.fused_block_edges,
                                     trace=trace, memo=args.memo)
 
         def drive_memo():
@@ -1007,7 +1036,9 @@ def run_serve_worker(args, dev, spec, cfg) -> int:
                              exact_impl=args.exact_impl,
                              megatick=args.megatick,
                              queue_engine=args.queue_engine,
-                             kernel_engine=args.kernel_engine)
+                             kernel_engine=args.kernel_engine,
+                             fused_tick=args.fused_tick,
+                             fused_block_edges=args.fused_block_edges)
 
     cache_dir = tempfile.mkdtemp(prefix="clsim-serve-exec-")
 
@@ -1149,6 +1180,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                 queue_engine=args.queue_engine,
                                 comm_engine=args.comm_engine,
                                 kernel_engine=args.kernel_engine,
+                                fused_tick=args.fused_tick,
                                 megatick=args.megatick)
     topo = runner.topo
     log(f"graphshard: {topo.n} nodes / {args.graphshard} shards "
@@ -1190,6 +1222,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                     queue_engine=args.queue_engine,
                                     comm_engine=args.comm_engine,
                                     kernel_engine=args.kernel_engine,
+                                    fused_tick=args.fused_tick,
                                     megatick=args.megatick)
 
     times, ticks_seen = [], []
@@ -1226,6 +1259,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "scheduler": "sync",
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
+        "fused_tick": runner.fused,
         "comm_engine": runner.comm_engine,
         "megatick": runner.megatick,
         # analytic per-shard per-tick bytes for both engines at THIS

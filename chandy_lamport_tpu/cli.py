@@ -213,7 +213,9 @@ def _cmd_storm(args) -> int:
                            check_every=args.check_every,
                            megatick=args.megatick,
                            kernel_engine=args.kernel_engine, faults=faults,
-                           quarantine=quarantine, trace=trace)
+                           quarantine=quarantine, trace=trace,
+                           fused_tick=args.fused_tick,
+                           fused_block_edges=args.fused_block_edges)
     prog = storm_program(
         runner.topo, phases=args.phases, amount=1,
         snapshot_phases=staggered_snapshots(runner.topo, args.snapshots, 1, 2,
@@ -361,6 +363,7 @@ def _cmd_stream(args) -> int:
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            kernel_engine=args.kernel_engine,
+                           fused_tick=args.fused_tick,
                            faults=faults, quarantine=faults is not None,
                            trace=trace, memo=args.memo,
                            memo_cache=args.memo_cache, guards=guards)
@@ -467,6 +470,7 @@ def _cmd_serve(args) -> int:
     runner = BatchedRunner(spec, cfg, make_fast_delay(args.delay, args.seed),
                            batch=args.batch, scheduler=args.scheduler,
                            kernel_engine=args.kernel_engine,
+                           fused_tick=args.fused_tick,
                            memo_cache=args.memo_cache,
                            memo_cache_entries=args.memo_cache_entries,
                            memo_cache_bytes=args.memo_cache_bytes,
@@ -642,6 +646,18 @@ def main(argv=None) -> int:
                          "reduction kernels (interpret-mode emulation off-"
                          "TPU), 'auto' = pallas only on TPU; bit-identical "
                          "results")
+    ps.add_argument("--fused-tick", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="one-kernel megatick (kernels/megatick.py): 'on' "
+                         "runs exact-path multi-tick/drain/flush loops as "
+                         "ONE Pallas kernel scanning K ticks VMEM-resident "
+                         "(needs --kernel-engine pallas and --megatick > "
+                         "1), 'auto' fuses exactly when eligible and the "
+                         "working set fits the VMEM budget; bit-identical "
+                         "results")
+    ps.add_argument("--fused-block-edges", type=int, default=0,
+                    help="fault-plane DMA block width for the fused "
+                         "megatick's HBM->VMEM mask stream (0 = default)")
     ps.add_argument("--check-every", type=int, default=0,
                     help="evaluate the token-conservation invariant inside "
                          "the run every K phases (0 = off); violations set "
@@ -767,6 +783,10 @@ def main(argv=None) -> int:
                     default="auto",
                     help="tick-kernel engine (chandy_lamport_tpu.kernels); "
                          "bit-identical results")
+    pq.add_argument("--fused-tick", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="one-kernel megatick knob (kernels/megatick.py); "
+                         "bit-identical results")
     pq.add_argument("--seed", type=int, default=0)
     pq.add_argument("--delay", choices=["uniform", "hash"], default="hash")
     pq.add_argument("--admission", choices=["stream", "gang"],
@@ -859,6 +879,8 @@ def main(argv=None) -> int:
     pz.add_argument("--snapshots", type=int, default=8)
     pz.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     pz.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],
+                    default="auto")
+    pz.add_argument("--fused-tick", choices=["auto", "on", "off"],
                     default="auto")
     pz.add_argument("--seed", type=int, default=0)
     pz.add_argument("--delay", choices=["uniform", "hash"], default="hash")

@@ -48,6 +48,15 @@ ENGINE_KNOBS = {
     # serve bench A/Bs against. No backend resolution — pure validation,
     # like "memo".
     "serve_policy": ("edf", "fifo"),
+    # one-kernel megatick (kernels/megatick.resolve_fused_tick): "auto"
+    # executes the whole K-tick run_ticks/drain scan inside a single
+    # VMEM-resident Pallas kernel whenever it applies (kernel_engine
+    # resolved to pallas, megatick > 1, ring markers, cascade/wave,
+    # supervisor and recorder off, working set within the VMEM budget)
+    # and falls back to the PR 9 split kernels otherwise; "on" raises on
+    # the first unmet requirement instead of silently splitting; "off"
+    # always splits. Bit-identical every way.
+    "fused_tick": ("auto", "on", "off"),
 }
 
 
@@ -121,6 +130,15 @@ class SimConfig:
     # Bit-identical results either way; runner kwargs override this
     # per-instance.
     kernel_engine: str = "auto"
+    # One-kernel megatick (kernels/megatick.py): fuse the exact path's
+    # whole K-tick scan — tick body, fault gates and all — into a single
+    # VMEM-resident Pallas kernel so queue/node state never round-trips
+    # HBM between ticks. "auto" engages it exactly where it applies and
+    # splits otherwise (resolve_fused_tick documents the gate), "on"
+    # raises when it cannot, "off" keeps the PR 9 split kernels. Runner
+    # kwargs override per-instance; bit-identical either way
+    # (tests/test_megatick_fused.py).
+    fused_tick: str = "auto"
     # Snapshot supervisor (ops/tick.TickKernel._supervise): with
     # snapshot_timeout > 0, a started snapshot that has not completed
     # within that many ticks of its (re-)initiation is aborted IN TRACE —
@@ -170,7 +188,7 @@ class SimConfig:
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
-        for knob in ("comm_engine", "kernel_engine"):
+        for knob in ("comm_engine", "kernel_engine", "fused_tick"):
             allowed = ENGINE_KNOBS[knob]
             if getattr(self, knob) not in allowed:
                 raise ValueError(

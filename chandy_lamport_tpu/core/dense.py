@@ -87,7 +87,8 @@ class DenseSim:
                  exact_impl: str = "cascade", megatick: int = 8,
                  queue_engine: str = "auto",
                  kernel_engine: Optional[str] = None, faults=None,
-                 trace=None):
+                 trace=None, fused_tick: Optional[str] = None,
+                 fused_block_edges: int = 0):
         """``megatick``: K-tick fusion depth for ``tick N`` events and the
         drain loop (ops/tick.TickKernel docstring); semantics-preserving,
         1 restores the reference-literal one-iteration-per-tick loops (the
@@ -103,7 +104,11 @@ class DenseSim:
         away entirely.
         ``trace``: utils/tracing.JaxTrace or None — arm the device flight
         recorder; ``self.trace`` then exposes the decoded timeline
-        (DenseTraceView). None compiles every trace op away."""
+        (DenseTraceView). None compiles every trace op away.
+        ``fused_tick``: one-kernel megatick knob ("auto"/"on"/"off",
+        kernels/megatick.py) — None defers to the config's knob;
+        ``self.fused`` exposes the resolution. ``fused_block_edges``
+        overrides the fault-plane DMA block width (0 = default)."""
         self.config = config or SimConfig()
         self.topo = DenseTopology(topology)
         self.delay = (delay_model if isinstance(delay_model, JaxDelay)
@@ -124,8 +129,10 @@ class DenseSim:
                                  exact_impl=exact_impl, megatick=megatick,
                                  queue_engine=queue_engine,
                                  kernel_engine=kernel_engine, faults=faults,
-                                 trace=trace)
+                                 trace=trace, fused_tick=fused_tick,
+                                 fused_block_edges=fused_block_edges)
         self.kernel_engine = self.kernel.kernel_engine
+        self.fused = self.kernel.fused
         # same surface as ParitySim: ``sim.trace`` is the timeline view
         # when armed, None otherwise
         self.trace = DenseTraceView(self) if self.kernel._trace_on else None

@@ -36,6 +36,23 @@ past ``E*C ~ 4M`` words need a real edge-blocked grid (the CSR layout's
 ``dst_lo/dst_hi`` bounds are the natural block boundaries) — future work,
 called out here so ``auto`` can gate on footprint when it lands.
 
+The one-kernel megatick (``megatick.py``, SimConfig.fused_tick) extends
+the same argument from one queue step to the WHOLE K-tick loop: the
+entire DenseState rides as VMEM operands of a single kernel whose body
+is a ``lax.scan`` of K full ticks, so state crosses HBM twice per K
+ticks instead of per stage per tick. Its budget line item on top of the
+state bytes is the streamed fault-plane scratch: ``2 slots · 8 rows ·
+NB·EB · 4 B`` of double-buffered VMEM plus a K-resident ``[K, 2, N]``
+node plane — ``megatick.plan_edge_blocks`` picks the edge-block width
+EB (default 512 -> 16 KB per DMA) and ``megatick.fused_vmem_bytes``
+totals the working set against ``megatick.FUSED_VMEM_BUDGET`` (12 MB of
+the ~16, the rest headroom for the tick body's intermediates); the
+``fused_tick='auto'`` gate (``megatick.resolve_fused_tick``) splits
+whenever that total doesn't fit. At the bench shape (E~2k, C=24, K=8)
+the carry is the ~1 MB state and the streaming scratch ~0.26 MB —
+comfortably resident; the 8k ladder (E~16k) fits until C pushes the
+``[E, C]`` rings past the budget, at which point auto falls back loudly.
+
 Inside the kernel bodies only TPU-lowerable jnp ops are used for the
 ``[E, C]`` work (``broadcasted_iota`` one-hot selects, ``cumsum``,
 ``where`` — no scatter); the segment kernels use the same exclusive
@@ -96,12 +113,17 @@ def pallas_interpret(backend: str | None = None) -> bool:
     return backend != "tpu"
 
 
-from chandy_lamport_tpu.kernels import queue, segment  # noqa: E402
+from chandy_lamport_tpu.kernels import megatick, queue, segment  # noqa: E402
+from chandy_lamport_tpu.kernels.megatick import (  # noqa: E402
+    resolve_fused_tick,
+)
 
 __all__ = [
     "KERNEL_ENGINES",
+    "megatick",
     "pallas_interpret",
     "queue",
+    "resolve_fused_tick",
     "resolve_kernel_engine",
     "segment",
 ]

@@ -1,0 +1,342 @@
+"""One-kernel megatick: the entire exact tick body, K ticks per kernel.
+
+PR 9 kernelized the ring-queue step and the segment reductions as
+*separate* ``pl.pallas_call``s, so a K-tick megatick still round-trips
+every working plane through HBM between pop -> route -> reduce -> spread
+-> append, K times. This module fuses the WHOLE tick body — head gather,
+eligibility, per-source first-eligible select, pop, routing, CSR segment
+reduce, node update/spread, ring append, error-bit fold — into one
+kernel, then ``lax.scan``s K megaticks INSIDE it, so queue/node state
+never leaves VMEM between ticks: one HBM read of the carry at kernel
+entry, one write at exit, regardless of K.
+
+The tick body itself is not re-derived here: ``fused_scan`` traces the
+caller's ``step_fn`` (ops/tick.TickKernel binds its stock-XLA cascade /
+wave tick, the formulation every engine arm is differentially pinned
+against) inside the kernel, so bit-identity with the split-kernel and
+XLA paths is by construction — the same jaxpr, executed VMEM-resident.
+
+Fault masks as input planes
+---------------------------
+The PR 9 split had to hop out of the kernel for the fault gates. Here
+the adversary moves in-kernel as masked lanes driven by PRECOMPUTED
+per-(tick, edge) fault planes: the stateless (fault_key, time, index)
+hash makes every mask for times t+1..t+K computable before the kernel
+launches (TickKernel._fault_planes), and the in-kernel scan consumes
+row j exactly when the j-th tick really executes — the quiescence /
+drain / quarantine gates are monotone, so ticks always run on a step
+prefix and the time<->row correspondence cannot slip. Semantics are
+byte-for-byte the hash-at-tick-time path's (tests/test_megatick_fused).
+
+Edge blocks, double buffering and the VMEM budget
+-------------------------------------------------
+The per-(tick, edge) planes are the one input that scales with K·E, so
+they stay in HBM (``pltpu.ANY``) and stream through a double-buffered
+async-copy pipeline over EDGE BLOCKS: the [K, R, E] plane is padded to
+NB·EB edges and laid out [K, NB, R, EB]; while the scan executes tick j
+out of VMEM slot ``j % 2``, the NB block copies for tick j+1 are already
+in flight into slot ``(j+1) % 2`` (one DMA semaphore per (slot, block)).
+The block size EB is chosen against the VMEM budget documented in
+``kernels/__init__.py``: carry ≈ state bytes (q planes 8·E·C B dominate,
+plus the [L, E] log and [S, E] window planes), streaming scratch adds
+``2 · R · NB · EB · 4`` B, and the whole working set must clear
+``FUSED_VMEM_BUDGET`` (12 MB of the ~16 MB/core, the rest left for the
+tick body's intermediates) — ``plan_edge_blocks`` / ``fused_vmem_bytes``
+below are that arithmetic, and ``resolve_fused_tick`` is the single
+gate deciding fused vs split (the ``fused_tick`` ENGINE_KNOBS row).
+
+Off-TPU everything runs under ``interpret=True`` like the PR 9 kernels,
+so CPU tier-1 exercises the fused body, the DMA pipeline included.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_i32 = jnp.int32
+
+# The fused working set must clear this: ~16 MB/core VMEM minus ~4 MB
+# left for the tick body's intermediates (one-hot masks, cumsums — the
+# same headroom argument as the split kernels' budget note).
+FUSED_VMEM_BUDGET = 12 * 1024 * 1024
+# Default edge-block width for the streamed fault planes: 512 edges x
+# R=8 rows x 4 B = 16 KB per block copy — large enough to amortize DMA
+# issue overhead, small enough that NB stays >= 2 on every graph the
+# test tree runs (so the pipeline's block loop is genuinely exercised).
+DEFAULT_BLOCK_EDGES = 512
+
+
+def plan_edge_blocks(e: int, block_edges: int = 0) -> tuple[int, int]:
+    """(NB, EB) for streaming an [.., E]-last plane in EB-edge blocks:
+    EB = ``block_edges`` (0 -> DEFAULT_BLOCK_EDGES, clamped to E so tiny
+    graphs get one exact block), NB = ceil(E / EB). The plane is padded
+    to NB·EB edges; callers slice the pad back off after each copy."""
+    if e <= 0:
+        raise ValueError(f"need at least one edge, got E={e}")
+    eb = int(block_edges) if block_edges else DEFAULT_BLOCK_EDGES
+    eb = max(1, min(eb, e))
+    nb = -(-e // eb)
+    return nb, eb
+
+
+def pytree_bytes(tree) -> int:
+    """Total array bytes of a pytree — the carry side of the VMEM math."""
+    return sum(x.size * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "dtype"))
+
+
+def fused_vmem_bytes(state_bytes: int, e: int, n: int, length: int,
+                     faulted: bool, block_edges: int = 0) -> int:
+    """The fused kernel's resident working set: the carry (state + loop
+    scalars) + the double-buffered edge-plane scratch (2 slots x 8 rows
+    x NB·EB i32) + the K-resident node plane (length x 2 x N i32).
+    Fault-free kernels stream nothing — carry only."""
+    total = state_bytes + 64        # + packed loop scalars
+    if faulted:
+        nb, eb = plan_edge_blocks(e, block_edges)
+        total += 2 * 8 * nb * eb * 4
+        total += length * 2 * n * 4
+    return total
+
+
+def resolve_fused_tick(fused_tick: str, *, kernel_engine: str,
+                       megatick: int, marker_mode: str, exact_impl: str,
+                       supervised: bool, traced: bool,
+                       vmem_bytes: int,
+                       budget: int = FUSED_VMEM_BUDGET
+                       ) -> tuple[str, str]:
+    """Resolve the ``fused_tick`` knob (config.ENGINE_KNOBS) to a
+    concrete ("on"|"off", reason). "auto" turns on exactly when the
+    one-kernel megatick applies:
+
+      * ``kernel_engine == "pallas"`` and ``megatick > 1`` — the fusion
+        IS the K-tick scan; K=1 has nothing to keep resident;
+      * ring markers + cascade/wave — the vectorized exact formulations
+        (the fold is the reference-literal specification form, and the
+        split representation never runs the exact tick);
+      * supervisor and flight recorder off — both paths fall back to
+        the split kernels (documented contract: composition is via
+        fallback, bit-identical by the megatick differentials; the
+        fault adversary, by contrast, runs genuinely in-kernel via the
+        precomputed mask planes);
+      * the working set fits the VMEM budget (fused_vmem_bytes).
+
+    "on" RAISES on the first unmet requirement instead of silently
+    splitting — the explicit spelling is the CI/profiling override and
+    must never lie about what ran. "off" always splits."""
+    if fused_tick not in ("auto", "on", "off"):
+        raise ValueError(f"unknown fused_tick {fused_tick!r}")
+    if fused_tick == "off":
+        return "off", "fused_tick='off'"
+    why = None
+    if kernel_engine != "pallas":
+        why = (f"kernel_engine={kernel_engine!r} (the fused megatick is "
+               f"a Pallas kernel)")
+    elif megatick <= 1:
+        why = f"megatick={megatick} (nothing to fuse below K=2)"
+    elif marker_mode != "ring":
+        why = (f"marker_mode={marker_mode!r} (the exact tick only runs "
+               f"on the ring representation)")
+    elif exact_impl not in ("cascade", "wave"):
+        why = (f"exact_impl={exact_impl!r} (the fold is the reference-"
+               f"literal specification form)")
+    elif supervised:
+        why = ("snapshot supervisor armed (supervised runs keep the "
+               "split kernels)")
+    elif traced:
+        why = ("flight recorder armed (traced runs keep the split "
+               "kernels)")
+    elif vmem_bytes > budget:
+        why = (f"working set {vmem_bytes} B exceeds the "
+               f"{budget} B VMEM budget")
+    if why is None:
+        return "on", "fused megatick engaged"
+    if fused_tick == "on":
+        raise ValueError(f"fused_tick='on' impossible: {why}")
+    return "off", why
+
+
+def _pack_edge_plane(plane, nb: int, eb: int):
+    """[K, R, E] -> [K, NB, R, EB] (zero-padded on E): the DMA layout —
+    one copy descriptor per (tick, block), blocks contiguous last."""
+    k, r, e = plane.shape
+    pad = nb * eb - e
+    if pad:
+        plane = jnp.pad(plane, ((0, 0), (0, 0), (0, pad)))
+    return jnp.transpose(plane.reshape(k, r, nb, eb), (0, 2, 1, 3))
+
+
+def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
+               interpret: bool, block_edges: int = 0, consts=None):
+    """Run ``length`` steps of ``step_fn`` inside ONE Pallas kernel with
+    the whole ``carry`` pytree VMEM-resident between steps.
+
+    ``step_fn(carry, ep_slice, aux_slice) -> carry`` is traced into the
+    kernel body; ``ep_slice`` is the step's [R, E] row of ``edge_plane``
+    ([length, R, E] i32, or None), delivered through the double-buffered
+    HBM->VMEM block pipeline described in the module docstring;
+    ``aux_slice`` is the step's row of ``aux_plane`` ([length, ...] or
+    None), which stays fully VMEM-resident (node-sized, cheap).
+
+    ``consts`` (optional pytree) carries the step body's loop-invariant
+    arrays — topology tables, permutations — which a Pallas kernel body
+    cannot close over (captured-constant error): they ride as VMEM
+    operands, are read once, and are handed to the step as a fourth
+    argument, ``step_fn(carry, ep, aux, consts)``.
+
+    Zero-size carry leaves (representation planes the exact tick never
+    touches — split-mode marker planes, a disarmed trace ring) bypass
+    the kernel and are reattached verbatim: step_fn must not write them
+    (the resolve_fused_tick gate guarantees the recorder is off).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    live = [i for i, x in enumerate(leaves) if jnp.size(x) > 0]
+    scalars = [jnp.ndim(leaves[i]) == 0 for i in live]
+    ins = [jnp.reshape(leaves[i], (1,)) if s else leaves[i]
+           for i, s in zip(live, scalars)]
+    out_shape = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in ins)
+
+    n_aux = 0
+    if aux_plane is not None:
+        aux_leaves, aux_def = jax.tree_util.tree_flatten(aux_plane)
+        n_aux = len(aux_leaves)
+    n_const = 0
+    if consts is not None:
+        const_leaves, const_def = jax.tree_util.tree_flatten(consts)
+        n_const = len(const_leaves)
+    e = nb = eb = 0
+    if edge_plane is not None:
+        k, r, e = edge_plane.shape
+        assert k == length
+        nb, eb = plan_edge_blocks(e, block_edges)
+        edge_plane = _pack_edge_plane(jnp.asarray(edge_plane, _i32), nb, eb)
+
+    def unpack_carry(refs):
+        vals = [ref[0] if s else ref[...] for ref, s in zip(refs, scalars)]
+        # dead (zero-size) leaves become in-kernel zeros — a leaf from
+        # the outer trace would be a captured constant, which Pallas
+        # rejects; the caller's originals are reattached after the call
+        full = [jnp.zeros(jnp.shape(x), x.dtype) for x in leaves]
+        for i, v in zip(live, vals):
+            full[i] = v
+        return jax.tree_util.tree_unflatten(treedef, full)
+
+    def pack_carry(c, out_refs):
+        out = jax.tree_util.tree_leaves(c)
+        for ref, i, s in zip(out_refs, live, scalars):
+            ref[...] = jnp.reshape(out[i], (1,)) if s else out[i]
+
+    def kernel(*refs):
+        n_in = len(ins)
+        in_refs = refs[:n_in]
+        aux_vals = [a[...] for a in refs[n_in:n_in + n_aux]]
+        cv = [c[...] for c in
+              refs[n_in + n_aux:n_in + n_aux + n_const]]
+        ep_ref = (refs[n_in + n_aux + n_const]
+                  if edge_plane is not None else None)
+        out_refs = refs[len(refs) - len(ins):]
+
+        c0 = unpack_carry(in_refs)
+        const_tree = (jax.tree_util.tree_unflatten(const_def, cv)
+                      if consts is not None else None)
+
+        def body(c, j, ep_vmem):
+            ep = None
+            if ep_vmem is not None:
+                # [NB, R, EB] -> [R, E]: undo the block layout, drop pad
+                ep = jnp.transpose(ep_vmem, (1, 0, 2)).reshape(-1, nb * eb)
+                ep = ep[:, :e]
+            ax = None
+            if aux_plane is not None:
+                ax = jax.tree_util.tree_unflatten(
+                    aux_def, [a[j] for a in aux_vals])
+            if consts is not None:
+                return step_fn(c, ep, ax, const_tree)
+            return step_fn(c, ep, ax)
+
+        if ep_ref is None:
+            def step(c, j):
+                return body(c, j, None), None
+
+            c, _ = lax.scan(step, c0, jnp.arange(length, dtype=_i32))
+            pack_carry(c, out_refs)
+            return
+
+        def inner(scratch, sem):
+            def copies(j, slot):
+                return [pltpu.make_async_copy(
+                    ep_ref.at[j, b], scratch.at[slot, b], sem.at[slot, b])
+                    for b in range(nb)]
+
+            for cp in copies(jnp.int32(0), jnp.int32(0)):
+                cp.start()
+
+            def step(c, j):
+                slot = lax.rem(j, jnp.int32(2))
+                for cp in copies(j, slot):
+                    cp.wait()
+                # prefetch tick j+1 into the other slot while tick j
+                # executes (the last step re-fetches its own row: the
+                # copy is started so the post-scan drain stays uniform,
+                # its data is never read)
+                nxt = jnp.minimum(j + 1, length - 1)
+                for cp in copies(nxt, lax.rem(j + 1, jnp.int32(2))):
+                    cp.start()
+                return body(c, j, scratch[slot]), None
+
+            c, _ = lax.scan(step, c0, jnp.arange(length, dtype=_i32))
+            for cp in copies(jnp.int32(length - 1),
+                             lax.rem(jnp.int32(length), jnp.int32(2))):
+                cp.wait()
+            pack_carry(c, out_refs)
+
+        pl.run_scoped(
+            inner,
+            scratch=pltpu.VMEM((2, nb, r, eb), _i32),
+            sem=pltpu.SemaphoreType.DMA((2, nb)))
+
+    # carry + aux ride as ordinary whole-array VMEM operands; only the
+    # K-scaling edge plane stays in ANY (HBM) behind the DMA pipeline.
+    operands = list(ins)
+    if aux_plane is not None:
+        operands += [jnp.asarray(a, _i32) for a in aux_leaves]
+    if consts is not None:
+        operands += list(const_leaves)
+    in_spec_list = [pl.BlockSpec(memory_space=pltpu.VMEM)
+                    for _ in operands]
+    if edge_plane is not None:
+        operands.append(edge_plane)
+        in_spec_list.append(pl.BlockSpec(memory_space=pltpu.ANY))
+
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=in_spec_list,
+        out_shape=out_shape,
+        interpret=interpret)(*operands)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    full = list(leaves)
+    for x, i, s in zip(outs, live, scalars):
+        full[i] = jnp.reshape(x, ()) if s else x
+    return jax.tree_util.tree_unflatten(treedef, full)
+
+
+def hbm_round_trip_model(state_bytes: int, plane_bytes: int, length: int,
+                         fused: bool) -> int:
+    """Analytic HBM traffic of one K-tick dispatch — what a compiled TPU
+    kernel would actually move, the metric the cost plane pins next to
+    the backend-dependent ``bytes_accessed`` (interpret-mode Pallas
+    inlines the kernel body into stock HLO, so XLA's byte count cannot
+    see the fusion; this model can). Split kernels re-read and re-write
+    the carry every tick (a deliberately conservative FLOOR — the real
+    split path round-trips per STAGE, not per tick); the fused kernel
+    reads the carry once, writes it once, and streams each fault-plane
+    row exactly once."""
+    if fused:
+        return 2 * state_bytes + plane_bytes
+    return 2 * state_bytes * max(length, 1) + plane_bytes

@@ -30,6 +30,8 @@ instance axis (parallel/batch.py).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -253,7 +255,9 @@ class TickKernel:
                  marker_mode: str = "ring", exact_impl: str = "cascade",
                  megatick: int = 8, queue_engine: str = "auto",
                  kernel_engine: str | None = None,
-                 faults=None, quarantine: bool = False, trace=None):
+                 faults=None, quarantine: bool = False, trace=None,
+                 fused_tick: str | None = None,
+                 fused_block_edges: int = 0):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -336,7 +340,24 @@ class TickKernel:
         contain zero trace ops and lower bit-identically to an
         uninstrumented build (tests/test_trace.py asserts this on the
         goldens). cfg.trace_capacity must be > 0 for an armed recorder
-        to have anywhere to write (runners bump it before building)."""
+        to have anywhere to write (runners bump it before building).
+
+        fused_tick ("auto"/"on"/"off", None defers to cfg.fused_tick)
+        selects the ONE-KERNEL megatick (kernels/megatick.py): with
+        kernel_engine='pallas' and megatick K > 1, the whole K-tick scan
+        of run_ticks / the exact drain executes inside a single Pallas
+        kernel with every working plane VMEM-resident between ticks, the
+        fault adversary riding along in-kernel as precomputed mask
+        planes (_fault_planes). kernels.megatick.resolve_fused_tick is
+        the gate ("auto" falls back to the split kernels whenever the
+        fused form doesn't apply — supervisor or recorder armed, fold
+        formulation, VMEM budget blown; "on" raises instead).
+        ``self.fused`` holds the resolved "on"/"off" and
+        ``self.fused_reason`` the reason. Bit-identical either way
+        (tests/test_megatick_fused.py). fused_block_edges overrides the
+        edge-block width of the fault-plane DMA pipeline (0 = the
+        plan_edge_blocks default; tests shrink it to force multi-block
+        geometry on small graphs)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
         if (faults is not None and marker_mode == "ring"
@@ -480,6 +501,46 @@ class TickKernel:
         self._exact_tick = {"cascade": self._cascade_tick,
                             "wave": self._wave_tick,
                             "fold": self._tick}[exact_impl]
+        # ---- one-kernel megatick resolution (kernels/megatick.py) ----
+        from chandy_lamport_tpu.kernels import megatick as plk_megatick
+
+        self.fused_tick = (cfg.fused_tick if fused_tick is None
+                           else fused_tick)
+        self.fused_block_edges = int(fused_block_edges)
+        vmem = 0
+        if self.fused_tick != "off":
+            # working-set arithmetic is only needed once the cheap gates
+            # can pass; init_state is host-side numpy, built transiently
+            from chandy_lamport_tpu.core.state import init_state
+
+            self._state_bytes = plk_megatick.pytree_bytes(
+                init_state(topo, cfg, None))
+            vmem = plk_megatick.fused_vmem_bytes(
+                self._state_bytes, topo.e, topo.n, self.megatick,
+                faults is not None, self.fused_block_edges)
+        self.fused, self.fused_reason = plk_megatick.resolve_fused_tick(
+            self.fused_tick,
+            kernel_engine=self.kernel_engine, megatick=self.megatick,
+            marker_mode=marker_mode, exact_impl=exact_impl,
+            supervised=self._sup, traced=self._trace_on, vmem_bytes=vmem)
+        if self.fused == "on":
+            # the tick body traced INSIDE the fused kernel: the same
+            # TickKernel, pinned to the stock-XLA formulations (no nested
+            # pallas_call) with segsum reductions (no [N, E] matmul
+            # constants resident in VMEM — integer-exact, bit-identical),
+            # the queue addressing inherited from the outer resolution.
+            # Everything else (faults, quarantine, formulation) matches,
+            # so _exact_tick's jaxpr is the one the split paths are
+            # differentially pinned against.
+            self._fused_inner = TickKernel(
+                topo,
+                dataclasses.replace(cfg, reduce_mode="segsum",
+                                    kernel_engine="xla",
+                                    fused_tick="off"),
+                delay, marker_mode="ring", exact_impl=exact_impl,
+                megatick=1, queue_engine=self.queue_engine,
+                kernel_engine="xla", faults=faults, quarantine=quarantine,
+                trace=None)
         if marker_mode == "split":
             # a split-mode kernel carries markers in the [S, E] pending
             # planes, not the rings, so no bit-exact formulation can run on
@@ -578,31 +639,43 @@ class TickKernel:
     # both vectorized exact formulations, so the fault semantics cannot
     # drift between schedulers.
 
-    def _fault_edge_masks(self, s: DenseState):
+    # Every hook takes an optional ``fmasks`` — the PRECOMPUTED mask
+    # bundle for this tick (_fmasks_of), used by the fused megatick whose
+    # in-kernel scan receives the whole adversary program as input planes
+    # (kernels/megatick.py). The hash is stateless in (fault_key, time,
+    # index) and fault_key is never advanced by a tick, so masks hashed
+    # ahead of time are byte-identical to masks hashed at tick time; with
+    # fmasks=None (every non-fused path) nothing changes.
+
+    def _fault_edge_masks(self, s: DenseState, fmasks=None):
         """(drop, dup, jitter) bool [E] + dup receive times i32 [E] for the
         CURRENT tick (s.time must already be incremented). Dup delays come
         from the fault stream, folded into [1, max_delay], so the delay
         sampler's stream is fault-invariant and every duplicate lands
         inside the drain's max_delay+1 flush window."""
+        if fmasks is not None:
+            return fmasks["edge"]
         drop_e, dup_e, jit_e, dupw_e = self.faults.edge_masks(
             s.fault_key, s.time, self.topo.e)
         dup_rt = s.time + 1 + jnp.asarray(
             dupw_e % jnp.uint32(max(self.cfg.max_delay, 1)), _i32)
         return drop_e, dup_e, jit_e, dup_rt
 
-    def _fault_marker_masks(self, s: DenseState):
+    def _fault_marker_masks(self, s: DenseState, fmasks=None):
         """(drop, dup, jitter) bool [E] + dup receive times i32 [E] for
         this tick's MARKER deliveries (models/faults.marker_masks): the
         control-plane fault program the snapshot supervisor exists to
         survive. Stateless per-tick hash — callers may recompute it
         within a tick and read identical masks."""
+        if fmasks is not None:
+            return fmasks["marker"]
         md_e, mu_e, mj_e, mw_e = self.faults.marker_masks(
             s.fault_key, s.time, self.topo.e)
         mdup_rt = s.time + 1 + jnp.asarray(
             mw_e % jnp.uint32(max(self.cfg.max_delay, 1)), _i32)
         return md_e, mu_e, mj_e, mdup_rt
 
-    def _fault_split_markers(self, s: DenseState, mk_pend):
+    def _fault_split_markers(self, s: DenseState, mk_pend, fmasks=None):
         """Split this tick's delivered-marker mask by the adversary's
         marker drop/dup program: a dropped marker vanishes on the wire
         (popped, never handled — exactly the loss that stalls a snapshot
@@ -610,7 +683,7 @@ class TickKernel:
         re-enqueued by the caller with a fault-stream receive time.
         Markers move no tokens, so no skew is booked. Returns
         (state, surviving-marker mask, dup mask, dup receive times)."""
-        mdrop_e, mdup_e, _, mdup_rt = self._fault_marker_masks(s)
+        mdrop_e, mdup_e, _, mdup_rt = self._fault_marker_masks(s, fmasks)
         dropped = mk_pend & mdrop_e
         duped = mk_pend & mdup_e & ~dropped
         counts = s.fault_counts.at[FC_MDROP].add(
@@ -624,7 +697,7 @@ class TickKernel:
         return s, mk_pend & ~dropped, duped, mdup_rt
 
     def _fault_gate_elig(self, s: DenseState, elig, jit_e, mjit_e=None,
-                         marker_front=None):
+                         marker_front=None, fmasks=None):
         """Apply the delivery-side fault gates to an eligibility mask:
         extra-delay jitter stalls the edge's front for this tick (with
         ``mjit_e``/``marker_front``, the marker-plane jitter program
@@ -641,7 +714,11 @@ class TickKernel:
             mblocked = elig & marker_front & mjit_e
             counts = counts.at[FC_MJITTER].add(jnp.sum(mblocked, dtype=_i32))
             blocked = blocked | mblocked
-        down_n = self.faults.down_nodes(s.fault_key, s.time, self.topo.n)
+        if fmasks is not None:
+            down_n = fmasks["down_n"]
+        else:
+            down_n = self.faults.down_nodes(s.fault_key, s.time,
+                                            self.topo.n)
         dead = elig & self._spread_dst(down_n)
         s = s._replace(fault_counts=counts)
         if self._trace_on:
@@ -673,7 +750,7 @@ class TickKernel:
             s = trace_append_many(s, duped, EV_FAULT, self._rows_e, FC_DUP)
         return s, tok_e & ~dropped, duped
 
-    def _fault_restart(self, s: DenseState) -> DenseState:
+    def _fault_restart(self, s: DenseState, fmasks=None) -> DenseState:
         """Crash-window restarts at tick start (s.time already incremented).
         'pause' mode only counts the event — node memory survived, resuming
         IS the recovery. 'lossy' mode is snapshot-rollback recovery: the
@@ -685,7 +762,10 @@ class TickKernel:
         balance delta lands in fault_skew so conservation stays exact."""
         f = self.faults
         n = self.topo.n
-        rs_n = f.restarted(s.fault_key, s.time, n)
+        if fmasks is not None:
+            rs_n = fmasks["rs_n"]
+        else:
+            rs_n = f.restarted(s.fault_key, s.time, n)
         counts = s.fault_counts.at[FC_CRASH].add(jnp.sum(rs_n, dtype=_i32))
         if self._trace_on:
             # the only FAULT event whose actor is a NODE, not an edge
@@ -707,6 +787,49 @@ class TickKernel:
                                               dtype=_i32),
             fault_counts=counts,
             error=s.error | err)
+
+    def _fault_planes(self, s: DenseState, K: int):
+        """The adversary's whole next-K-ticks program as two dense i32
+        planes — the fused megatick's input contract (kernels/megatick):
+        edge plane [K, 8, E] with rows (drop, dup, jit, dup_rt, mdrop,
+        mdup, mjit, mdup_rt), node plane [K, 2, N] with rows (down_n,
+        rs_n). Row j holds the masks for tick time ``s.time + 1 + j`` —
+        the time the j-th in-kernel step ticks at if every step before
+        it ticked, which the megatick loops guarantee (their gates are
+        monotone, so real ticks always form a step PREFIX; see
+        _run_ticks / _drain_and_flush_with). The hash is stateless and
+        fault_key is tick-invariant, so these are bit-identical to the
+        masks the hooks would hash mid-tick."""
+        f = self.faults
+        e, n = self.topo.e, self.topo.n
+        md = jnp.uint32(max(self.cfg.max_delay, 1))
+
+        def row(t):
+            drop, dup, jit, dupw = f.edge_masks(s.fault_key, t, e)
+            mdrop, mdup, mjit, mw = f.marker_masks(s.fault_key, t, e)
+            ep = jnp.stack([
+                drop.astype(_i32), dup.astype(_i32), jit.astype(_i32),
+                t + 1 + jnp.asarray(dupw % md, _i32),
+                mdrop.astype(_i32), mdup.astype(_i32), mjit.astype(_i32),
+                t + 1 + jnp.asarray(mw % md, _i32)])
+            npl = jnp.stack([
+                f.down_nodes(s.fault_key, t, n).astype(_i32),
+                f.restarted(s.fault_key, t, n).astype(_i32)])
+            return ep, npl
+
+        times = s.time + 1 + jnp.arange(K, dtype=_i32)
+        return jax.vmap(row)(times)            # [K, 8, E], [K, 2, N]
+
+    @staticmethod
+    def _fmasks_of(ep, npl):
+        """One step's plane slices ([8, E], [2, N]) -> the ``fmasks``
+        bundle every fault hook accepts in place of hashing."""
+        def b(x):
+            return x.astype(jnp.bool_)
+
+        return {"edge": (b(ep[0]), b(ep[1]), b(ep[2]), ep[3]),
+                "marker": (b(ep[4]), b(ep[5]), b(ep[6]), ep[7]),
+                "down_n": b(npl[0]), "rs_n": b(npl[1])}
 
     # ---- snapshot supervisor (SimConfig.snapshot_timeout/_every) ---------
     # Traced only when self._sup (the faults=None zero-cost contract: an
@@ -1200,7 +1323,7 @@ class TickKernel:
 
     # ---- shared tick-start machinery for the vectorized exact forms -----
 
-    def _select_and_pop(self, s: DenseState):
+    def _select_and_pop(self, s: DenseState, fmasks=None):
         """Tick-start delivery selection shared by the cascade and wave
         formulations (fact 1 in _cascade_tick's docstring: selection is
         invariant over the fold, so every selected head can be popped up
@@ -1228,9 +1351,10 @@ class TickKernel:
             # marker-plane jitter program stalls marker fronts on top),
             # a down destination receives nothing (messages wait,
             # lossless)
-            _, _, jit_e, _ = self._fault_edge_masks(s)
-            _, _, mjit_e, _ = self._fault_marker_masks(s)
-            s, elig = self._fault_gate_elig(s, elig, jit_e, mjit_e, head_mk)
+            _, _, jit_e, _ = self._fault_edge_masks(s, fmasks)
+            _, _, mjit_e, _ = self._fault_marker_masks(s, fmasks)
+            s, elig = self._fault_gate_elig(s, elig, jit_e, mjit_e, head_mk,
+                                            fmasks)
         if self.kernel_engine == "pallas":
             sel, new_head, new_len = plk_queue.select_pop(
                 s.q_head, s.q_len, elig, self._src_first, capacity=C,
@@ -1269,7 +1393,7 @@ class TickKernel:
 
     # ---- the cascade tick: bit-exact semantics without the N-step fold ---
 
-    def _cascade_tick(self, s: DenseState) -> DenseState:
+    def _cascade_tick(self, s: DenseState, fmasks=None) -> DenseState:
         """Bit-identical to ``_tick`` (the reference fold, sim.go:71-95) but
         O(E) vector work + one sequential step per MARKER delivered, instead
         of an N-step scan per tick.
@@ -1320,10 +1444,10 @@ class TickKernel:
         s = s._replace(time=s.time + 1)
         dup_pend = dup_rt = mk_dup = mdup_rt = None
         if self.faults is not None:
-            s = self._fault_restart(s)
+            s = self._fault_restart(s, fmasks)
         if self._sup:
             s = self._supervise(s)
-        s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
+        s, tok_pend, mk_pend, head_data = self._select_and_pop(s, fmasks)
         if self.faults is not None:
             # drop/dup act on the popped token set; the marker fold below
             # never sees a dropped token (it vanished on the wire), and
@@ -1332,11 +1456,11 @@ class TickKernel:
             # marker-plane program does the same to the popped markers —
             # a dropped marker is exactly the control-plane loss the
             # supervisor's timeout recovers from
-            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
+            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s, fmasks)
             s, tok_pend, dup_pend = self._fault_split_tokens(
                 s, tok_pend, head_data, drop_e, dup_e)
             s, mk_pend, mk_dup, mdup_rt = self._fault_split_markers(
-                s, mk_pend)
+                s, mk_pend, fmasks)
         # superseded-epoch markers die here (counted), and sid_e becomes
         # the decoded slot id (the raw payload when unsupervised)
         s, mk_pend, sid_e = self._reject_stale(s, mk_pend, head_data)
@@ -1406,7 +1530,7 @@ class TickKernel:
 
     # ---- the wave tick: the cascade with cross-destination parallelism --
 
-    def _wave_tick(self, s: DenseState) -> DenseState:
+    def _wave_tick(self, s: DenseState, fmasks=None) -> DenseState:
         """Bit-identical to ``_cascade_tick`` for position-addressable delay
         samplers (JaxDelay.position_streams), but each sequential step
         processes EVERY pending marker bound for a distinct destination at
@@ -1451,19 +1575,19 @@ class TickKernel:
         s = s._replace(time=s.time + 1)
         dup_pend = dup_rt = mk_dup = mdup_rt = None
         if self.faults is not None:
-            s = self._fault_restart(s)
+            s = self._fault_restart(s, fmasks)
         if self._sup:
             s = self._supervise(s)
         time = s.time
-        s, tok_pend, mk_pend, head_data = self._select_and_pop(s)
+        s, tok_pend, mk_pend, head_data = self._select_and_pop(s, fmasks)
         if self.faults is not None:
             # same drop/dup discipline as the cascade (one shared hook
             # set), token and marker planes alike
-            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s)
+            drop_e, dup_e, _, dup_rt = self._fault_edge_masks(s, fmasks)
             s, tok_pend, dup_pend = self._fault_split_tokens(
                 s, tok_pend, head_data, drop_e, dup_e)
             s, mk_pend, mk_dup, mdup_rt = self._fault_split_markers(
-                s, mk_pend)
+                s, mk_pend, fmasks)
         s, mk_pend, sid_e = self._reject_stale(s, mk_pend, head_data)
         amt_e = jnp.where(tok_pend, head_data, 0)
         rank_e = self._rows_e                   # fold rank == edge index
@@ -1941,13 +2065,84 @@ class TickKernel:
             t = lax.cond(quiet, bump, self._exact_tick, t)
             return (t, quiet), None
 
-        def mega(c):
-            (t, _), _ = lax.scan(
-                step, (c[0], jnp.bool_(False)), None, length=K)
-            return t, c[1] + K
+        if self.fused == "on":
+            # the same K-step scan, executed INSIDE one Pallas kernel
+            # with the whole carry VMEM-resident (kernels/megatick). The
+            # quiet mask is monotone (quiet |= halted), so ticks run on a
+            # step prefix and the fault planes' row/time correspondence
+            # holds: a quiet step bumps time WITHOUT consuming its row.
+            def mega(c):
+                t = self._fused_mega_ticks(c[0], halted, bump)
+                return t, c[1] + K
+        else:
+            def mega(c):
+                (t, _), _ = lax.scan(
+                    step, (c[0], jnp.bool_(False)), None, length=K)
+                return t, c[1] + K
 
         s, i = lax.while_loop(live, mega, (s, i))
         return credit(s, i)
+
+    def _fused_call(self, step, carry, s: DenseState, length: int):
+        """Dispatch ``step`` through kernels.megatick.fused_scan with the
+        fault planes (when armed) and the inner tick body's loop-invariant
+        arrays — topology tables, permutations, CSR bounds — riding as
+        kernel operands. A Pallas body cannot close over arrays, so the
+        inner kernel's jax.Array attributes are swapped for their
+        operand-read values for the duration of the in-kernel trace and
+        restored after (the swap only exists while fused_scan traces)."""
+        from chandy_lamport_tpu.kernels.megatick import fused_scan
+
+        fm_e = fm_n = None
+        if self.faults is not None:
+            fm_e, fm_n = self._fault_planes(s, length)
+        inner = self._fused_inner
+        cvals = {n: v for n, v in sorted(vars(inner).items())
+                 if isinstance(v, jax.Array)}
+
+        def step_c(c, ep, ax, cv):
+            for n, v in cv.items():
+                setattr(inner, n, v)
+            try:
+                return step(c, ep, ax)
+            finally:
+                # restore BEFORE the in-kernel trace is finalized: the
+                # kernel jaxpr is leak-checked the moment pallas_call
+                # finishes tracing, which is before the outer finally
+                # below runs — operand tracers left on ``inner`` there
+                # trip jax.checking_leaks (the runtime sentry's regime)
+                for n, v in cvals.items():
+                    setattr(inner, n, v)
+
+        try:
+            return fused_scan(step_c, carry, fm_e, fm_n, length=length,
+                              interpret=self._pl_interpret,
+                              block_edges=self.fused_block_edges,
+                              consts=cvals)
+        finally:
+            for n, v in cvals.items():
+                setattr(inner, n, v)
+
+    def _fused_mega_ticks(self, s: DenseState, halted, bump) -> DenseState:
+        """One fused megatick for the run_ticks loop: K ticks in one
+        kernel dispatch, cumulative-quiescence semantics identical to the
+        plain scan body above."""
+        inner = self._fused_inner
+
+        def step(carry, ep, ax):
+            t, quiet = carry
+            quiet = quiet | halted(t)
+            fmk = None if ep is None else self._fmasks_of(ep, ax)
+
+            def run(u):
+                return inner._exact_tick(u, fmk)
+
+            t = lax.cond(quiet, bump, run, t)
+            return t, quiet
+
+        t, _ = self._fused_call(step, (s, jnp.bool_(False)), s,
+                                self.megatick)
+        return t
 
     # ---- event injection (sim.go:58-68) ---------------------------------
 
@@ -2110,7 +2305,8 @@ class TickKernel:
                        & (s.completed < self.topo.n))
 
     def _drain_and_flush_with(self, s: DenseState, tick_fn,
-                              megatick: int = 1) -> DenseState:
+                              megatick: int = 1,
+                              fused_ok: bool = False) -> DenseState:
         """Tick until every started snapshot has completed on all nodes, then
         max_delay+1 flush ticks. Outcome-equivalent to the reference's
         goroutine drain loop (SURVEY.md §3.5), with a tick-budget guard in
@@ -2124,18 +2320,34 @@ class TickKernel:
         With ``quarantine`` on, ``error != 0`` halts a lane exactly like
         the completion exit — a poisoned lane freezes (flush ticks
         included) instead of grinding its corrupt state forward, and it is
-        NOT charged ERR_TICK_LIMIT for the ticks quarantine denied it."""
+        NOT charged ERR_TICK_LIMIT for the ticks quarantine denied it.
+
+        ``fused_ok`` (only ever True from _drain_and_flush, the exact
+        path) lets a ``fused == 'on'`` kernel execute the K-tick drain
+        body and the flush loop inside the one-kernel megatick. The
+        drain condition is monotone non-increasing within a megatick
+        (started/snap_failed are fixed with the supervisor off — the
+        fused gate guarantees that — completed only grows, error is
+        sticky, and a condition-false step freezes time), so real ticks
+        form a step prefix and the precomputed fault planes' row/time
+        correspondence holds; the traced ``limit`` rides in the kernel
+        carry rather than being closed over."""
+        fused = fused_ok and self.fused == "on"
         limit = jnp.asarray(s.time + self.cfg.max_ticks, _i32)
 
-        if self.quarantine:
-            def cond(s):
-                return (self._pending(s) & (s.time < limit)
-                        & (s.error == 0))
-        else:
-            def cond(s):
-                return self._pending(s) & (s.time < limit)
+        def cond_at(s, lim):
+            c = self._pending(s) & (s.time < lim)
+            if self.quarantine:
+                c = c & (s.error == 0)
+            return c
 
-        if megatick > 1:
+        def cond(s):
+            return cond_at(s, limit)
+
+        if fused:
+            def body(s):
+                return self._fused_drain_mega(s, limit, cond_at)
+        elif megatick > 1:
             def body(s):
                 def step(s, _):
                     return lax.cond(cond(s), tick_fn, lambda t: t, s), None
@@ -2150,6 +2362,8 @@ class TickKernel:
             budget_blown = budget_blown & (s.error == 0)
         s = s._replace(error=s.error | jnp.where(
             budget_blown, ERR_TICK_LIMIT, 0).astype(_i32))
+        if fused:
+            return self._fused_flush(s)
         flush = tick_fn
         if self.quarantine:
             def flush(s):
@@ -2157,9 +2371,48 @@ class TickKernel:
         return lax.fori_loop(0, self.cfg.max_delay + 1,
                              lambda _, s: flush(s), s)
 
+    def _fused_drain_mega(self, s: DenseState, limit, cond_at) -> DenseState:
+        """One fused K-tick drain body: the megatick>1 scan above, inside
+        the kernel, re-checking the drain condition per step."""
+        inner = self._fused_inner
+
+        def step(carry, ep, ax):
+            t, lim = carry
+            fmk = None if ep is None else self._fmasks_of(ep, ax)
+
+            def run(u):
+                return inner._exact_tick(u, fmk)
+
+            t = lax.cond(cond_at(t, lim), run, lambda u: u, t)
+            return t, lim
+
+        t, _ = self._fused_call(step, (s, limit), s, self.megatick)
+        return t
+
+    def _fused_flush(self, s: DenseState) -> DenseState:
+        """The max_delay+1 flush ticks in one kernel. Flush ticks run
+        unconditionally (time advances every step), so the fault planes
+        align row j with flush tick j; under quarantine an errored lane
+        freezes — error is sticky, the identity steps are a suffix."""
+        inner = self._fused_inner
+        quarantine = self.quarantine
+
+        def step(t, ep, ax):
+            fmk = None if ep is None else self._fmasks_of(ep, ax)
+
+            def run(u):
+                return inner._exact_tick(u, fmk)
+
+            if quarantine:
+                return lax.cond(t.error == 0, run, lambda u: u, t)
+            return run(t)
+
+        return self._fused_call(step, s, s, self.cfg.max_delay + 1)
+
     def _drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._exact_tick,
-                                          megatick=self.megatick)
+                                          megatick=self.megatick,
+                                          fused_ok=True)
 
     def _sync_drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._sync_tick)

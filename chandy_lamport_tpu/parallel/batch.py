@@ -358,7 +358,8 @@ class BatchedRunner:
                  quarantine: bool = False, trace=None,
                  memo: str = "off", memo_cache: Optional[str] = None,
                  memo_cache_entries: int = 0, memo_cache_bytes: int = 0,
-                 guards=None):
+                 guards=None, fused_tick: Optional[str] = None,
+                 fused_block_edges: int = 0):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -404,6 +405,19 @@ class BatchedRunner:
         (drained stretches in O(1)) applies at every K, including 1.
         Semantics-preserving knob either way; bench --megatick exposes
         it for the on-device A/B.
+
+        fused_tick: the one-kernel megatick knob ("auto"/"on"/"off",
+        kernels/megatick.resolve_fused_tick) — None (default) defers to
+        the config's knob. When it resolves "on" the exact path's
+        multi-tick/drain/flush loops run as single Pallas kernels whose
+        bodies scan K full ticks with the whole DenseState VMEM-resident
+        (TickKernel docstring); bit-identical either way, and because
+        the runner binds ``kernel._run_ticks``/``kernel._drain_and_flush``
+        directly, the fused dispatch propagates to the storm/stream
+        engines with no code here. ``self.fused`` exposes the resolution
+        ("on"/"off") and ``self.fused_reason`` the why; bench
+        --fused-tick stamps the row. ``fused_block_edges`` overrides the
+        fault-plane DMA block width (0 = default).
 
         queue_engine: ring-queue addressing (ops/tick.TickKernel): "gather"
         = O(E) head gathers + append scatters over the packed planes,
@@ -505,9 +519,12 @@ class BatchedRunner:
             marker_mode="split" if scheduler == "sync" else "ring",
             exact_impl=exact_impl, megatick=megatick,
             queue_engine=queue_engine, kernel_engine=kernel_engine,
-            faults=faults, quarantine=quarantine, trace=trace)
+            faults=faults, quarantine=quarantine, trace=trace,
+            fused_tick=fused_tick, fused_block_edges=fused_block_edges)
         self.queue_engine = self.kernel.queue_engine
         self.kernel_engine = self.kernel.kernel_engine
+        self.fused = self.kernel.fused
+        self.fused_reason = self.kernel.fused_reason
         self.faults = faults
         self.quarantine = bool(quarantine)
         self._trace_on = self.kernel._trace_on
@@ -1049,6 +1066,7 @@ class BatchedRunner:
         knobs = {
             "queue_engine": self.queue_engine,
             "kernel_engine": self.kernel_engine,
+            "fused_tick": self.fused,
             "exact_impl": self.kernel.exact_impl,
             "megatick": self.megatick,
             "check_every": self.check_every,

@@ -277,7 +277,9 @@ class GraphShardedRunner:
                  check_every: int = 0, queue_engine: str = "auto",
                  comm_engine: Optional[str] = None,
                  kernel_engine: Optional[str] = None, megatick: int = 1,
-                 quarantine: bool = False, trace=None, guards=None):
+                 quarantine: bool = False, trace=None, guards=None,
+                 fused_tick: Optional[str] = None,
+                 fused_block_edges: int = 0):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -331,7 +333,17 @@ class GraphShardedRunner:
         recorder: snapshot lifecycle (start/end) and supervisor actions
         (abort/retry/fail) append to the replicated trace ring (the
         ShardedState tr_* docstring explains why per-node/per-edge events
-        stay out). None (default) compiles the trace ops away."""
+        stay out). None (default) compiles the trace ops away.
+
+        fused_tick: the one-kernel megatick knob (kernels/megatick.py).
+        Accepted for knob-surface uniformity (bench stamps every runner
+        row with it) but the sharded tick can never fuse: every tick
+        body crosses shard boundaries — the halo exchange / psum between
+        the send half and the delivery half — and a Pallas kernel body
+        cannot contain collectives over the graph mesh. "auto" and "off"
+        both resolve "off" here; "on" raises, naming the constraint.
+        ``fused_block_edges`` is accepted and ignored for the same
+        reason."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.guards = guards
@@ -358,6 +370,21 @@ class GraphShardedRunner:
             self.config.kernel_engine if kernel_engine is None
             else kernel_engine)
         self._pl_interpret = pallas_interpret()
+        # the fused-megatick knob resolves "off" unconditionally here
+        # (docstring above); validate the spelling + honor an explicit
+        # "on" with a loud refusal rather than a silent downgrade
+        ft = self.config.fused_tick if fused_tick is None else fused_tick
+        from chandy_lamport_tpu.config import ENGINE_KNOBS
+        if ft not in ENGINE_KNOBS["fused_tick"]:
+            raise ValueError(f"unknown fused_tick {ft!r}")
+        if ft == "on":
+            raise ValueError(
+                "fused_tick='on' impossible: the sharded tick exchanges "
+                "boundary rows (halo/psum) inside every tick body, which "
+                "a single Pallas kernel cannot contain")
+        self.fused = "off"
+        self.fused_reason = ("sharded tick crosses shard boundaries "
+                             "inside the tick body")
         if megatick < 1:
             raise ValueError("megatick must be >= 1")
         self.megatick = int(megatick)
@@ -1403,6 +1430,7 @@ class GraphShardedRunner:
             "comm_engine": self.comm_engine,
             "queue_engine": self.queue_engine,
             "kernel_engine": self.kernel_engine,
+            "fused_tick": self.fused,
             "megatick": self.megatick,
             "total_ticks": int(np.sum(np.asarray(h.time))),
             "error_bits": bits,

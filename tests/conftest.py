@@ -74,6 +74,50 @@ def ring8_sync_stream_runner():
 
 
 @pytest.fixture(scope="session")
+def fused_pair10():
+    """ONE split/fused TickKernel pair on the loaded strongly-connected
+    10-node graph, shared across the fused-megatick differentials
+    (tests/test_megatick_fused.py): the fused arm's interpret-mode
+    Pallas compile is among the heaviest in the tier-1 gate, and every
+    differential drives the identical (topology, config, delay) shape —
+    per-test copies would pay it once per test. Jit caches live on the
+    kernel instances, so sharing the instances shares the compiles.
+    Returns ``(kern_split, kern_fused, state)``: both kernels are
+    cascade/gather/megatick=4 (kernel_engine=pallas so the SPLIT arm
+    exercises the per-stage kernels too; fused_block_edges=5 forces
+    multi-block DMA geometry on the 21-edge graph), ``state`` carries
+    live traffic plus one snapshot in flight. Tests must not mutate the
+    kernels or the state (run/drain return fresh pytrees; arms needing
+    other knobs build their own)."""
+    import random
+
+    import numpy as np
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.core.state import DenseTopology, init_state
+    from chandy_lamport_tpu.ops.delay_jax import HashJaxDelay
+    from chandy_lamport_tpu.ops.tick import TickKernel
+    from chandy_lamport_tpu.utils.randgen import random_strongly_connected
+
+    topo = DenseTopology(random_strongly_connected(random.Random(11), 10))
+    cfg = SimConfig(max_snapshots=4, queue_capacity=32, max_recorded=64)
+    delay = HashJaxDelay(seed=7)
+    kern_split = TickKernel(topo, cfg, delay, exact_impl="cascade",
+                            megatick=4, kernel_engine="pallas",
+                            fused_tick="off")
+    kern_fused = TickKernel(topo, cfg, delay, exact_impl="cascade",
+                            megatick=4, kernel_engine="pallas",
+                            fused_tick="on", fused_block_edges=5)
+    s = init_state(topo, cfg, delay.init_state())
+    for e in range(0, topo.e, 3):
+        s = kern_split.inject_send(s, np.int32(e), np.int32(2))
+    s = kern_split.inject_snapshot(s, np.int32(0))
+    # host-side: run_ticks/drain_and_flush donate their state argument,
+    # which would delete a shared device-resident fixture on first use
+    return kern_split, kern_fused, jax.device_get(s)
+
+
+@pytest.fixture(scope="session")
 def batched8_default_ref():
     """The auto-layouts battery's shared reference arm: ONE default-layout
     (row-major) runner on the 8nodes golden topology plus its phases-6

@@ -305,6 +305,7 @@ def test_worker_row_round_trips_kernel_engine(engine, capsys):
     assert row["value"] > 0
 
 
+@pytest.mark.slow
 def test_stream_worker_row_round_trips_memo_books(capsys):
     """A real (tiny, CPU) --stream --worker A/B under the memo plane: the
     row must carry the memo knob, the dup mix, the coalesce/cache/

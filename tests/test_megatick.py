@@ -135,15 +135,16 @@ _GOLDEN_IDS = [events.removesuffix(".events")
                for _, events, _ in REFERENCE_TESTS]
 
 
-_BIG_GOLDENS = {"8nodes-sequential-snapshots", "8nodes-concurrent-snapshots",
+_BIG_GOLDENS = {"3nodes-bidirectional-messages",
+                "8nodes-sequential-snapshots", "8nodes-concurrent-snapshots",
                 "10nodes"}
 
 
 @pytest.mark.parametrize(
     "top,events",
-    # the three big-fixture cases are ~50s of compile between them; the
+    # the four big-fixture cases are ~60s of compile between them; the
     # small fixtures + the hash-delay lane-0 test below keep the wave-vs-
-    # cascade differential in tier-1, the big three run in full passes
+    # cascade differential in tier-1, the big four run in full passes
     [pytest.param(t, e, marks=([pytest.mark.slow]
                                if e.removesuffix(".events") in _BIG_GOLDENS
                                else []))

@@ -1,0 +1,395 @@
+"""The one-kernel megatick (kernels/megatick.py, SimConfig.fused_tick):
+bit-identity, gating, and pipeline edge geometry.
+
+Three claims, all on the CPU mesh via interpret-mode Pallas:
+
+1. The fused K-tick kernel — the whole tick body lax.scanned inside ONE
+   pallas_call with the state VMEM-resident and the per-(tick,edge)
+   fault masks DMA-streamed in edge blocks — is bit-identical to the
+   split-kernel path (and, via the goldens, to the XLA oracle) on every
+   plane, including fault books, error bits, and the sampler stream
+   position.
+
+2. ``resolve_fused_tick`` gates honestly: "auto" engages exactly when
+   the documented requirements hold, "on" raises naming the first
+   unmet requirement, and the supervisor/trace arms fall back with a
+   stated reason instead of silently changing semantics.
+
+3. The double-buffered HBM->VMEM mask pipeline survives every edge-
+   geometry corner: E not divisible by the block width, single-edge
+   graphs, capacity-1 rings, markers landing exactly on a DMA block
+   boundary, and K far past quiescence (the fast-forward prefix).
+
+The heaviest legs (full golden sweep x impl x queue engine, deep fault
+matrices) are slow-marked; tier-1 keeps one arm per axis plus the shared
+``fused_pair10`` session fixture (conftest.py) so the expensive fused
+compile is paid once.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.dense import DenseSim
+from chandy_lamport_tpu.core.state import DenseTopology, init_state
+from chandy_lamport_tpu.kernels import megatick as plk
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import ring_topology
+from chandy_lamport_tpu.ops.delay_jax import FixedJaxDelay, HashJaxDelay
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+from chandy_lamport_tpu.utils.fixtures import (
+    read_events_file,
+    read_topology_file,
+)
+from chandy_lamport_tpu.utils.goldens import REFERENCE_TESTS, fixture_path
+from chandy_lamport_tpu.utils.randgen import random_strongly_connected
+
+
+def _assert_identical(a, b):
+    assert dense_state_mismatches(jax.device_get(a), jax.device_get(b)) == []
+
+
+def _pair(exact_impl="cascade", queue_engine="auto", megatick=4,
+          block_edges=5, faults=None, n=10, cfg=None, spec=None, seed=7):
+    """A (split, fused, loaded state) triple on the strongly-connected
+    10-node graph (the conftest fixture's recipe, parameterizable)."""
+    topo = DenseTopology(spec if spec is not None
+                         else random_strongly_connected(random.Random(11), n))
+    cfg = cfg or SimConfig(max_snapshots=4, queue_capacity=32,
+                           max_recorded=64)
+    delay = HashJaxDelay(seed=seed)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl=exact_impl,
+                          megatick=megatick, queue_engine=queue_engine,
+                          kernel_engine="pallas", faults=faults,
+                          quarantine=faults is not None,
+                          fused_tick=fused, fused_block_edges=block_edges)
+
+    split, fused = mk("off"), mk("on")
+    s = init_state(topo, cfg, delay.init_state(),
+                   fault_key=int(faults.init_state()) if faults else 0)
+    for e in range(0, topo.e, 3):
+        s = split.inject_send(s, np.int32(e), np.int32(2))
+    s = split.inject_snapshot(s, np.int32(0))
+    # host-side: the jitted entry points donate their state argument
+    return split, fused, jax.device_get(s)
+
+
+# ---------------------------------------------------------------------------
+# resolution gate + block planning (pure functions, no compile)
+
+
+def test_plan_edge_blocks_geometry():
+    # E divisible, E ragged, E smaller than one block, degenerate E=1
+    assert plk.plan_edge_blocks(1024, 512) == (2, 512)
+    assert plk.plan_edge_blocks(21, 5) == (5, 5)      # last block ragged
+    assert plk.plan_edge_blocks(3, 512) == (1, 3)     # clamped to E
+    assert plk.plan_edge_blocks(1, 0) == (1, 1)
+    with pytest.raises(ValueError):
+        plk.plan_edge_blocks(0)
+
+
+def test_resolve_fused_tick_auto_gate():
+    base = dict(kernel_engine="pallas", megatick=4, marker_mode="ring",
+                exact_impl="cascade", supervised=False, traced=False,
+                vmem_bytes=1 << 20)
+    on, why = plk.resolve_fused_tick("auto", **base)
+    assert on == "on" and "engaged" in why
+    for knob, bad, word in (
+            ("kernel_engine", "xla", "kernel_engine"),
+            ("megatick", 1, "megatick"),
+            ("marker_mode", "split", "marker"),
+            ("exact_impl", "fold", "exact_impl"),
+            ("supervised", True, "supervisor"),
+            ("traced", True, "trace"),
+            ("vmem_bytes", plk.FUSED_VMEM_BUDGET + 1, "VMEM")):
+        off, why = plk.resolve_fused_tick("auto", **{**base, knob: bad})
+        assert off == "off", knob
+        assert word.lower() in why.lower(), (knob, why)
+    assert plk.resolve_fused_tick("off", **base) == ("off", "fused_tick='off'")
+
+
+def test_resolve_fused_tick_on_raises_naming_requirement():
+    base = dict(kernel_engine="pallas", megatick=4, marker_mode="ring",
+                exact_impl="cascade", supervised=False, traced=False,
+                vmem_bytes=1 << 20)
+    with pytest.raises(ValueError, match="kernel_engine"):
+        plk.resolve_fused_tick("on", **{**base, "kernel_engine": "xla"})
+    with pytest.raises(ValueError, match="megatick"):
+        plk.resolve_fused_tick("on", **{**base, "megatick": 1})
+    with pytest.raises(ValueError, match="unknown fused_tick"):
+        plk.resolve_fused_tick("sideways", **base)
+
+
+def test_fused_vmem_budget_math():
+    # the documented line items: carry + slack, plus the streaming
+    # scratch (2 slots x 8 rows x NB*EB words) and the [K,2,N] node
+    # plane only when the adversary is armed
+    base = plk.fused_vmem_bytes(1000, e=21, n=10, length=4, faulted=False)
+    assert base == 1000 + 64
+    nb, eb = plk.plan_edge_blocks(21, 5)
+    armed = plk.fused_vmem_bytes(1000, e=21, n=10, length=4, faulted=True,
+                                 block_edges=5)
+    assert armed == 1000 + 64 + 2 * 8 * nb * eb * 4 + 4 * 2 * 10 * 4
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: fused vs split (the shared fixture pays the compile once)
+
+
+def test_fused_matches_split_run_and_drain(fused_pair10):
+    split, fused, s = fused_pair10
+    _assert_identical(fused.run_ticks(s, np.int32(9)),
+                      split.run_ticks(s, np.int32(9)))
+    _assert_identical(fused.drain_and_flush(s), split.drain_and_flush(s))
+
+
+@pytest.mark.slow
+def test_fused_matches_split_under_jit_vmap(fused_pair10):
+    """The batched regime: the fused kernel under jit(vmap(.)), per-lane
+    states differing in load. Bit-identity must hold lane-wise."""
+    split, fused, s = fused_pair10
+    batch = jax.tree_util.tree_map(
+        lambda x: jnp.stack([x, x]), jax.device_get(s))
+    ran_f = jax.jit(jax.vmap(lambda t: fused._run_ticks(t, jnp.int32(6))))(
+        batch)
+    ran_s = jax.jit(jax.vmap(lambda t: split._run_ticks(t, jnp.int32(6))))(
+        batch)
+    for lane in range(2):
+        _assert_identical(
+            jax.tree_util.tree_map(lambda x: x[lane], ran_f),
+            jax.tree_util.tree_map(lambda x: x[lane], ran_s))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl,qe", [("wave", "mask"), ("wave", "gather"),
+                                     ("cascade", "mask")])
+def test_fused_matches_split_other_arms(impl, qe):
+    """The off-diagonal impl x queue-engine arms (the fixture covers
+    cascade/gather, the tier-1 golden re-covers it end-to-end; these
+    ride in full passes — each pays a fresh ~25 s fused compile)."""
+    split, fused, s = _pair(exact_impl=impl, queue_engine=qe)
+    _assert_identical(fused.run_ticks(s, np.int32(9)),
+                      split.run_ticks(s, np.int32(9)))
+    _assert_identical(fused.drain_and_flush(s), split.drain_and_flush(s))
+
+
+@pytest.mark.slow
+def test_fused_matches_split_with_message_faults():
+    """The in-kernel fault gates — masked lanes driven by the streamed
+    per-(tick,edge) planes — vs the split path's per-tick hash draws:
+    identical books (fault_counts), identical state. (Tier-1's fault
+    sentinel is test_fused_marker_on_block_boundary below — marker
+    faults across the DMA seam, one compile instead of two.)"""
+    faults = JaxFaults(3, drop_rate=0.2, dup_rate=0.15, jitter_rate=0.2,
+                       marker_drop_rate=0.1, marker_dup_rate=0.15,
+                       marker_jitter_rate=0.2)
+    split, fused, s = _pair(faults=faults)
+    a = fused.drain_and_flush(s)
+    b = split.drain_and_flush(s)
+    assert int(np.asarray(jax.device_get(a.fault_counts)).sum()) > 0
+    _assert_identical(a, b)
+
+
+@pytest.mark.slow
+def test_fused_matches_split_with_crashes_and_quarantine():
+    faults = JaxFaults(5, crash_rate=0.3, crash_len=3, crash_period=8,
+                       crash_mode="lossy")
+    split, fused, s = _pair(faults=faults)
+    a = fused.drain_and_flush(s)
+    b = split.drain_and_flush(s)
+    assert int(np.asarray(jax.device_get(a.fault_counts))[3]) > 0
+    _assert_identical(a, b)
+
+
+# ---------------------------------------------------------------------------
+# pipeline edge geometry (all interpret mode, all tier-1)
+
+
+@pytest.mark.slow
+def test_fused_block_width_not_dividing_edge_count():
+    """E=21 with EB=4: five full blocks + one ragged block of 1. The
+    reconstruction slice must drop exactly the pad lanes. (Tier-1
+    already exercises ragged geometry through the shared fixture's
+    EB=5-on-21-edges layout; this pins a second width in full passes.)"""
+    split, fused, s = _pair(block_edges=4)
+    _assert_identical(fused.run_ticks(s, np.int32(5)),
+                      split.run_ticks(s, np.int32(5)))
+
+
+def test_fused_single_edge_graph():
+    """E=1 degenerates the pipeline to one single-lane block per tick."""
+    from chandy_lamport_tpu.utils.fixtures import TopologySpec
+    topo = DenseTopology(TopologySpec([("A", 5), ("B", 0)], [("A", "B")]))
+    cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=16)
+    delay = FixedJaxDelay(2)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl="cascade",
+                          megatick=3, kernel_engine="pallas",
+                          fused_tick=fused)
+
+    split, fused_k = mk("off"), mk("on")
+    s = init_state(topo, cfg, delay.init_state())
+    s = split.inject_send(s, np.int32(0), np.int32(2))
+    s = jax.device_get(split.inject_snapshot(s, np.int32(0)))
+    _assert_identical(fused_k.run_ticks(s, np.int32(7)),
+                      split.run_ticks(s, np.int32(7)))
+
+
+def test_fused_capacity_one_ring():
+    """queue_capacity=1: every ring plane is a [E,1] sliver and one
+    marker fills an edge; overflow bits (if any) must agree bit-for-bit
+    with the split path."""
+    cfg = SimConfig(max_snapshots=2, queue_capacity=1, max_recorded=8)
+    topo = DenseTopology(ring_topology(4, tokens=4))
+    delay = FixedJaxDelay(1)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl="cascade",
+                          megatick=2, kernel_engine="pallas",
+                          fused_tick=fused)
+
+    split, fused_k = mk("off"), mk("on")
+    s = init_state(topo, cfg, delay.init_state())
+    s = jax.device_get(split.inject_snapshot(s, np.int32(0)))
+    _assert_identical(fused_k.drain_and_flush(s),
+                      split.drain_and_flush(s))
+
+
+def test_fused_marker_on_block_boundary():
+    """Ring of 8 (E=8), EB=4: node 4's out-edge is edge 4 — the first
+    lane of DMA block 1 — so the marker's fault-mask lane crosses the
+    double-buffer seam exactly at the boundary."""
+    faults = JaxFaults(9, marker_drop_rate=0.25, marker_jitter_rate=0.25)
+    cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=16)
+    topo = DenseTopology(ring_topology(8, tokens=8))
+    delay = HashJaxDelay(seed=13)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl="cascade",
+                          megatick=4, kernel_engine="pallas", faults=faults,
+                          quarantine=True, fused_tick=fused,
+                          fused_block_edges=4)
+
+    split, fused_k = mk("off"), mk("on")
+    s = init_state(topo, cfg, delay.init_state(),
+                   fault_key=int(faults.init_state()))
+    s = jax.device_get(split.inject_snapshot(s, np.int32(4)))
+    _assert_identical(fused_k.drain_and_flush(s),
+                      split.drain_and_flush(s))
+
+
+def test_fused_megatick_past_quiescence(fused_pair10):
+    """K=4 megaticks scanned far past this workload's drain point: the
+    quiet prefix must fast-forward (time still advances) without
+    consuming fault-plane rows differently than the split path."""
+    split, fused, s = fused_pair10
+    a = fused.run_ticks(s, np.int32(60))
+    b = split.run_ticks(s, np.int32(60))
+    assert int(jax.device_get(a.time)) == 60
+    _assert_identical(a, b)
+
+
+# ---------------------------------------------------------------------------
+# composition + plumbing
+
+
+def test_fused_auto_falls_back_for_supervisor_and_trace():
+    topo = DenseTopology(ring_topology(4, tokens=4))
+    delay = FixedJaxDelay(2)
+    sup_cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=8,
+                        snapshot_timeout=8)
+    kern = TickKernel(topo, sup_cfg, delay, megatick=4,
+                      kernel_engine="pallas", fused_tick="auto")
+    assert kern.fused == "off" and "supervisor" in kern.fused_reason
+
+    from chandy_lamport_tpu.utils.tracing import JaxTrace
+    tr_cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=8,
+                       trace_capacity=16)
+    kern = TickKernel(topo, tr_cfg, delay, megatick=4,
+                      kernel_engine="pallas", fused_tick="auto",
+                      trace=JaxTrace(capacity=16))
+    assert kern.fused == "off" and "trace" in kern.fused_reason
+
+
+def test_fused_knob_surfaces_on_runners():
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    spec = ring_topology(4, tokens=4)
+    cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=8)
+    sim = DenseSim(spec, FixedJaxDelay(2), cfg, megatick=4,
+                   kernel_engine="pallas", fused_tick="on")
+    assert sim.fused == "on"
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=2,
+                           scheduler="exact", megatick=4,
+                           kernel_engine="pallas", fused_tick="on")
+    assert runner.fused == "on"
+    # xla engine: "auto" resolves off with the engine named
+    runner = BatchedRunner(spec, cfg, FixedJaxDelay(2), batch=2,
+                           scheduler="exact", megatick=4,
+                           kernel_engine="xla", fused_tick="auto")
+    assert runner.fused == "off"
+    assert "kernel_engine" in runner.fused_reason
+
+
+def test_graphshard_refuses_fused_on():
+    from jax.sharding import Mesh
+
+    from chandy_lamport_tpu.parallel.graphshard import GraphShardedRunner
+    spec = ring_topology(8, tokens=8)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("graph",))
+    gs = GraphShardedRunner(spec, SimConfig(max_snapshots=2), mesh)
+    assert gs.fused == "off" and "shard" in gs.fused_reason
+    with pytest.raises(ValueError, match="fused_tick='on' impossible"):
+        GraphShardedRunner(spec, SimConfig(max_snapshots=2), mesh,
+                           fused_tick="on")
+
+
+# ---------------------------------------------------------------------------
+# goldens: fused vs the XLA oracle on the reference scripts
+
+_GOLDEN_IDS = [e.removesuffix(".events") for _, e, _ in REFERENCE_TESTS]
+
+
+def _golden_diff(top, events, impl, qe):
+    spec = read_topology_file(fixture_path(top))
+    evs = read_events_file(fixture_path(events))
+    cfg = SimConfig(max_snapshots=16, queue_capacity=64, max_recorded=64)
+
+    oracle = DenseSim(spec, FixedJaxDelay(2), cfg, exact_impl=impl,
+                      megatick=1, kernel_engine="xla")
+    snaps_ref = oracle.run_events(evs)
+
+    fused = DenseSim(spec, FixedJaxDelay(2), cfg, exact_impl=impl,
+                     megatick=4, queue_engine=qe, kernel_engine="pallas",
+                     fused_tick="on")
+    assert fused.fused == "on"
+    snaps = fused.run_events(evs)
+    _assert_identical(fused.state, oracle.state)
+    assert snaps == snaps_ref
+
+
+def test_golden_fused_matches_xla_oracle_tier1():
+    """One golden through the fused engine vs the unfused XLA oracle:
+    the cheap tier-1 sentinel for the full slow sweep below."""
+    top, events, _ = REFERENCE_TESTS[2]            # 3nodes-simple
+    _golden_diff(top, events, "cascade", "gather")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["cascade", "wave"])
+@pytest.mark.parametrize("qe", ["gather", "mask"])
+@pytest.mark.parametrize("top,events",
+                         [(t, e) for t, e, _ in REFERENCE_TESTS],
+                         ids=_GOLDEN_IDS)
+def test_golden_fused_matches_xla_oracle_full(top, events, impl, qe):
+    """The acceptance sweep: all 7 goldens x {cascade,wave} x
+    {gather,mask}, fused vs the sequential XLA oracle — decoded
+    snapshots AND every final state plane."""
+    _golden_diff(top, events, impl, qe)

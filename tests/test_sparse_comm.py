@@ -109,6 +109,7 @@ def test_cross_boundary_both_directions():
     assert model["sparse_bytes_per_tick"] > 0
 
 
+@pytest.mark.slow
 def test_zero_cut_elides_every_collective():
     """Two disconnected components, one per shard: no boundary edges, so
     the sparse engine's halo is 0 and the ppermute loops vanish

@@ -255,7 +255,7 @@ def test_jaxpr_fast_plane_clean():
     vs, audited, _ = jaxpr_audit.audit("fast", check_fingerprints=True)
     kept, _allowed = apply_allowlist(vs)
     assert kept == [], [v.to_dict() for v in kept]
-    assert len(audited) == 5
+    assert len(audited) == 6
 
 
 def test_host_sync_flags_item_float_and_carry_asarray():

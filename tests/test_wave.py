@@ -169,6 +169,7 @@ def test_wave_capacity_edge_matches_cascade():
     _assert_states_identical(outs[0][1]._host(), outs[1][1]._host())
 
 
+@pytest.mark.slow
 def test_wave_push_overflow_matches_cascade():
     """The wave's vectorized re-broadcast must flag ERR_QUEUE_OVERFLOW at
     exactly the same boundary as the cascade's sequential _push: a marker

@@ -180,6 +180,24 @@ def analyze_cost() -> None:
               f"{int(e.get('scatter_count', 0)):>5} "
               f"{int(e.get('fusion_count', 0)):>5}")
 
+    fused_arms = {k: e for k, e in entries.items()
+                  if k.startswith("tick.fused.") and "hbm_model_bytes" in e}
+    if fused_arms:
+        print(f"\nfused megatick HBM cross-check (kernels/megatick."
+              f"hbm_round_trip_model, bytes per K-tick dispatch; the "
+              f"split model is a per-tick carry round-trip FLOOR):")
+        for key in sorted(fused_arms):
+            split_key = key.replace("tick.fused.", "tick.megasplit.")
+            split = entries.get(split_key)
+            if not (split and split.get("hbm_model_bytes")):
+                continue
+            f_b = fused_arms[key]["hbm_model_bytes"]
+            s_b = split["hbm_model_bytes"]
+            ratio = f_b / s_b
+            print(f"  {key:<44} fused {int(f_b):>7} B vs split "
+                  f"{int(s_b):>7} B  (fused/split {ratio:.3f}"
+                  f"{', <=0.5 OK' if ratio <= 0.5 else ''})")
+
     dense = entries.get("graphshard.dispatch.comm=dense")
     sparse = entries.get("graphshard.dispatch.comm=sparse")
     if not (dense and sparse and dense.get("collective_bytes")):

@@ -285,6 +285,66 @@ def main() -> None:
         print(f"  {name:<12} {x * 1e3:10.3f} {pl_t * 1e3:10.3f} "
               f"{x / pl_t:7.2f}x", file=sys.stderr)
 
+    # ---- megakernel A/B: the one-kernel-megatick claim, measured --------
+    # Wall clock of a K-tick dispatch (`run_ticks(K)`, megatick=K) under
+    # three arms per K: "xla" = the stock formulations, "split" = the
+    # per-stage Pallas kernels (kernel_engine=pallas, fused_tick=off),
+    # "fused" = the one-kernel megatick (kernels/megatick.py: the whole
+    # K-tick loop as ONE kernel, state VMEM-resident between ticks).
+    # Off-TPU both Pallas columns are interpret-mode emulation — the
+    # comparison is about the TPU regime, where the fused arm's HBM
+    # round trips drop to ~1/K of split's (the cost plane's
+    # hbm_model_bytes metric pins exactly this). K=1 has no fused arm by
+    # construction (resolve_fused_tick requires megatick > 1).
+    mk_impl = (args.exact_impl if args.exact_impl in ("cascade", "wave")
+               else "cascade")
+    # the fused arms need the unified marker ring; under --scheduler sync
+    # the main runner's states carry split-marker planes, so the section
+    # gets its own exact-mode runner (same graph, same delay stream)
+    mk_runner = (runner if args.scheduler == "exact" else BatchedRunner(
+        spec, SimConfig.for_workload(
+            snapshots=args.snapshots, max_recorded=16,
+            record_dtype="int16", window_dtype=args.window_dtype,
+            reduce_mode=args.reduce_mode),
+        make_fast_delay(args.delay, 17), batch=args.batch,
+        scheduler="exact", exact_impl=mk_impl,
+        queue_engine=args.queue_engine))
+    mktimings = {}
+    for k_ticks in (1, 4, 16):
+        for arm, (engine, fused) in (("xla", ("xla", "off")),
+                                     ("split", ("pallas", "off")),
+                                     ("fused", ("pallas", "on"))):
+            if arm == "fused" and k_ticks == 1:
+                continue
+            k_mk = TickKernel(mk_runner.topo, mk_runner.config,
+                              mk_runner.delay,
+                              marker_mode="ring", exact_impl=mk_impl,
+                              megatick=k_ticks, queue_engine=args.queue_engine,
+                              kernel_engine=engine, fused_tick=fused)
+            jfn = jax.jit(jax.vmap(
+                lambda t, k=k_mk, n=k_ticks: k._run_ticks(
+                    t, jax.numpy.int32(n))))
+            st = mk_runner.init_batch_device()
+            out = jfn(st)                  # compile + warm
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jfn(st)
+            jax.block_until_ready(out)
+            mktimings[(k_ticks, arm)] = (time.perf_counter() - t0) / reps
+    print(f"megakernel (run_ticks(K) per dispatch, impl={mk_impl}{note}):",
+          file=sys.stderr)
+    print(f"  {'K':<4} {'xla ms':>10} {'split ms':>10} {'fused ms':>10} "
+          f"{'fused vs split':>14}", file=sys.stderr)
+    for k_ticks in (1, 4, 16):
+        x = mktimings[(k_ticks, "xla")]
+        sp = mktimings[(k_ticks, "split")]
+        fu = mktimings.get((k_ticks, "fused"))
+        fused_col = f"{fu * 1e3:10.3f}" if fu is not None else f"{'—':>10}"
+        ratio = f"{sp / fu:13.2f}x" if fu is not None else f"{'n/a':>14}"
+        print(f"  {k_ticks:<4} {x * 1e3:10.3f} {sp * 1e3:10.3f} "
+              f"{fused_col} {ratio}", file=sys.stderr)
+
     # ---- refill: the streaming engine's harvest + admit tax, measured ---
     # Per-step cost of continuous lane scheduling (parallel/batch.
     # _build_stream_step): the full jitted stream step — harvest retiring

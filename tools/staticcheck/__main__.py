@@ -63,7 +63,21 @@ def main(argv=None) -> int:
     ap.add_argument("--no-budgets", action="store_true",
                     help="measure the cost plane but skip the budget "
                          "comparison")
+    ap.add_argument("--regen-registries", action="store_true",
+                    help="regenerate BOTH registries — lowering "
+                         "fingerprints AND cost budgets — in one run "
+                         "(forces the jaxpr+cost planes on top of "
+                         "--plane, implies --fingerprints-update and "
+                         "--budgets-update). The one command a PR that "
+                         "intentionally changes a lowering or a cost "
+                         "ceiling needs; prints a loud reminder when "
+                         "either registry was recorded under a different "
+                         "jax version than the running one")
     args = ap.parse_args(argv)
+
+    if args.regen_registries:
+        args.fingerprints_update = True
+        args.budgets_update = True
 
     planes = {
         "jaxpr": ("jaxpr",),
@@ -73,12 +87,34 @@ def main(argv=None) -> int:
         "both": ("jaxpr", "ast"),
         "all": ("jaxpr", "ast", "cost", "runtime"),
     }[args.plane]
+    if args.regen_registries:
+        # both registries regenerate from the same process so their
+        # recorded jax versions can never drift apart
+        planes = tuple(dict.fromkeys(planes + ("jaxpr", "cost")))
 
     # only the jax-touching planes need jax (and the pinned audit env) at
     # all — a lint-only run must stay import-light and never mutate XLA
     # env vars
     if set(planes) & {"jaxpr", "cost", "runtime"}:
         jaxpr_audit.ensure_env()
+
+    if args.regen_registries:
+        # loud stale-version reminder BEFORE regenerating: a registry
+        # recorded under another jax is about to be re-pinned under this
+        # one, which rebinds the comparison gate to this toolchain
+        import jax
+        for label, loader in (("fingerprints.json",
+                               jaxpr_audit.load_registry),
+                              ("cost_budgets.json", hlo_cost.load_budgets)):
+            try:
+                _, recorded = loader()
+            except ValueError:
+                recorded = None
+            if recorded is not None and recorded != jax.__version__:
+                print(f"staticcheck: REMINDER — {label} was recorded "
+                      f"under jax {recorded}; regenerating under jax "
+                      f"{jax.__version__} re-pins every gate to this "
+                      f"toolchain", file=sys.stderr)
 
     violations = []
     audited = []

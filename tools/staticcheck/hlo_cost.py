@@ -88,10 +88,15 @@ _DEF_RE = re.compile(
 
 _SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
 
-# budget metrics: floats get FLOAT_TOL headroom, counts are exact
+# budget metrics: floats get FLOAT_TOL headroom, counts are exact.
+# hbm_model_bytes is the ANALYTIC HBM round trip of the megatick arms
+# (kernels/megatick.hbm_round_trip_model, merged in via Entry.extra_cost)
+# — the metric that proves the fusion: the fused arm's recorded ceiling
+# sits at ~1/K of its split twin's, which compiled-bytes can't show for
+# interpret-mode Pallas
 FLOAT_METRICS = ("flops", "bytes_accessed", "argument_bytes",
                  "output_bytes", "temp_bytes", "peak_buffer_bytes",
-                 "collective_bytes")
+                 "collective_bytes", "hbm_model_bytes")
 
 
 def _shape_bytes(shape: str) -> int:
@@ -167,7 +172,10 @@ def measure_entry(entry: Entry) -> Dict[str, float]:
     fn = entry.jit_fn
     if fn is None:
         fn = entry.fn if hasattr(entry.fn, "lower") else jax.jit(entry.fn)
-    return measure_compiled(fn.lower(*entry.args).compile())
+    row = measure_compiled(fn.lower(*entry.args).compile())
+    if entry.extra_cost:
+        row.update(entry.extra_cost)
+    return row
 
 
 # ---------------------------------------------------------------------------

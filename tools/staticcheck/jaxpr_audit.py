@@ -107,6 +107,11 @@ class Entry:
     axis_names: FrozenSet[str] = frozenset()
     state_out: bool = True
     const_budget: int = DEFAULT_CONST_BUDGET
+    # analytic metrics merged into the cost plane's measured row (the
+    # fused-megatick arms pin hbm_model_bytes here: interpret-mode Pallas
+    # inlines the kernel into stock HLO, so XLA's bytes_accessed cannot
+    # see the fusion — kernels/megatick.hbm_round_trip_model can)
+    extra_cost: Optional[Dict[str, float]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +174,57 @@ def _tick_entry(impl, qe, ke, faults, trace) -> Entry:
     key = (f"tick.{impl}.q={qe}.k={ke}.f={int(faults)}.t={int(trace)}")
     return Entry(key=key, fn=kern._exact_tick, args=(state,),
                  jit_fn=kern.tick, donated=(0,))
+
+
+def _fused_kernel(*, exact_impl="cascade", queue_engine="gather",
+                  fused="on", faults=False, n=8):
+    """A TickKernel on the one-kernel-megatick arm (kernels/megatick.py):
+    kernel_engine=pallas + megatick=4 + fused_tick='on' runs the whole
+    K-tick loop as ONE interpret-mode Pallas kernel; the 'off' twin is
+    the split-kernel baseline the cost plane compares against. K=4 so
+    the hbm_model_bytes ratio (fused reads the carry once, split once
+    per tick) clears the <=50% gate on the faulted arms too, where the
+    streamed plane bytes are common to both sides."""
+    from chandy_lamport_tpu.ops.tick import TickKernel
+    cfg = _cfg()
+    topo = _tick_topo(n)
+    delay = _delay()
+    kern = TickKernel(
+        topo, cfg, delay, exact_impl=exact_impl, megatick=4,
+        queue_engine=queue_engine, kernel_engine="pallas",
+        faults=_faults() if faults else None, fused_tick=fused)
+    from chandy_lamport_tpu.core.state import init_state
+    state = init_state(topo, cfg, delay.init_state(),
+                       fault_key=3 if faults else 0)
+    return kern, state
+
+
+def _fused_extra(kern, state, faults: bool, length: int) -> Dict[str, float]:
+    """The analytic HBM round-trip metrics for one fused/split arm
+    (megatick.hbm_round_trip_model): the cost plane pins both so the
+    fused arm's ceiling provably sits at <= 50% of the split arm's."""
+    from chandy_lamport_tpu.kernels import megatick as mt
+    state_bytes = mt.pytree_bytes(state)
+    plane_bytes = (length * (8 * kern.topo.e + 2 * kern.topo.n) * 4
+                   if faults else 0)
+    return {"hbm_model_bytes": float(mt.hbm_round_trip_model(
+        state_bytes, plane_bytes, length, fused=kern.fused == "on"))}
+
+
+def _fused_entry(impl, qe, faults, surface, fused="on") -> Entry:
+    import jax.numpy as jnp
+    kern, state = _fused_kernel(exact_impl=impl, queue_engine=qe,
+                                fused=fused, faults=faults)
+    tag = "fused" if fused == "on" else "megasplit"
+    key = f"tick.{tag}.{impl}.q={qe}.f={int(faults)}.{surface}"
+    extra = _fused_extra(kern, state, faults, kern.megatick)
+    if surface == "run_ticks":
+        return Entry(key=key, fn=kern._run_ticks,
+                     args=(state, jnp.int32(4)), jit_fn=kern.run_ticks,
+                     donated=(0,), extra_cost=extra)
+    return Entry(key=key, fn=kern._drain_and_flush, args=(state,),
+                 jit_fn=kern.drain_and_flush, donated=(0,),
+                 extra_cost=extra)
 
 
 def _sync_entry(qe, ke, faults, trace) -> Entry:
@@ -343,8 +399,10 @@ def iter_entry_builders(mode: str = "full"):
     inject entries, both storm schedulers, the stream step (plain, under
     memo="full" — which adds the rolling state-signature plane — and
     under serve=True, which adds the bounded exec-order admission plus
-    deadline/tenant harvest books), both graphshard comm engines, and
-    the Pallas kernels under interpret.
+    deadline/tenant harvest books), both graphshard comm engines, the
+    Pallas kernels under interpret, and the one-kernel-megatick arms
+    (fused impl x queue x faults on run_ticks, fused drain, and the
+    split-kernel twins that anchor the hbm_model_bytes comparison).
 
     fast — one arm per engine axis on the same tiny graphs: enough for
     tier-1 to prove the audit machinery against live traces without
@@ -363,6 +421,8 @@ def iter_entry_builders(mode: str = "full"):
             ("sync.q=gather.k=xla.f=0.t=0",
              lambda: _sync_entry("gather", "xla", False, False)),
             ("pallas.queue_step", lambda: _pallas_entry("queue_step")),
+            ("tick.fused.cascade.q=gather.f=0.run_ticks",
+             lambda: _fused_entry("cascade", "gather", False, "run_ticks")),
         ]
         yield from picks
         return
@@ -385,6 +445,23 @@ def iter_entry_builders(mode: str = "full"):
                     key = f"sync.q={qe}.k={ke}.f={int(faults)}.t={int(trace)}"
                     yield key, (lambda q=qe, k=ke, f=faults, t=trace:
                                 _sync_entry(q, k, f, t))
+    # the one-kernel-megatick arms (kernels/megatick.py): every fused
+    # impl x queue-engine x adversary combination on the multi-tick
+    # surface, the drain surface on the cascade/gather diagonal, plus
+    # the split-kernel twins whose hbm_model_bytes the fused arms must
+    # halve (ISSUE-14 acceptance: fused ceiling <= 50% of split)
+    for impl in ("cascade", "wave"):
+        for qe in ("gather", "mask"):
+            for faults in (False, True):
+                key = f"tick.fused.{impl}.q={qe}.f={int(faults)}.run_ticks"
+                yield key, (lambda i=impl, q=qe, f=faults:
+                            _fused_entry(i, q, f, "run_ticks"))
+    for faults in (False, True):
+        yield f"tick.fused.cascade.q=gather.f={int(faults)}.drain", (
+            lambda f=faults: _fused_entry("cascade", "gather", f, "drain"))
+        yield f"tick.megasplit.cascade.q=gather.f={int(faults)}.run_ticks", (
+            lambda f=faults: _fused_entry("cascade", "gather", f,
+                                          "run_ticks", fused="off"))
     for name, key in (("run_ticks", "tick.run_ticks"),
                       ("drain", "tick.drain_and_flush"),
                       ("inject_send", "tick.inject_send"),

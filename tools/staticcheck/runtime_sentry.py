@@ -20,8 +20,10 @@ entirely, as an XlaRuntimeError from the transfer guard) and fails
 named.
 
 Rows (full mode): stream {sync,exact} x memo {off,admit,full} + serve
-{edf,fifo} + one graphshard storm arm. Fast mode keeps one row per
-loop family for tier-1.
+{edf,fifo} + one graphshard storm arm + one fused-megatick stream arm
+(kernel_engine=pallas, fused_tick=on: the steady-state loop dispatches
+the one-kernel megatick, proving the fused path adds no host sync or
+retrace). Fast mode keeps one row per loop family for tier-1.
 """
 
 from __future__ import annotations
@@ -53,14 +55,14 @@ def _topo():
     return ring_topology(8, tokens=16)
 
 
-def _runner(scheduler: str, memo: str, guards):
+def _runner(scheduler: str, memo: str, guards, **knobs):
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
     return BatchedRunner(
         _topo(), SimConfig.for_workload(snapshots=2, max_recorded=32),
         make_fast_delay("hash", 7), 2, scheduler=scheduler, megatick=2,
-        memo=memo, guards=guards)
+        memo=memo, guards=guards, **knobs)
 
 
 def _check_books(key: str, books: dict, allowed: FrozenSet[str],
@@ -82,13 +84,13 @@ def _check_books(key: str, books: dict, allowed: FrozenSet[str],
     return out
 
 
-def _stream_row(key: str, scheduler: str, memo: str) -> Tuple[
+def _stream_row(key: str, scheduler: str, memo: str, **knobs) -> Tuple[
         List[Violation], int]:
     from chandy_lamport_tpu.models.workloads import stream_jobs
     from chandy_lamport_tpu.utils.guards import RuntimeGuards
 
     guards = RuntimeGuards()
-    runner = _runner(scheduler, memo, guards)
+    runner = _runner(scheduler, memo, guards, **knobs)
     jobs = stream_jobs(_topo(), 6, seed=5, base_phases=2, max_phases=4,
                        dup_rate=0.5 if memo != "off" else 0.0)
     pool = runner.pack_jobs(jobs,
@@ -176,6 +178,13 @@ def iter_rows(mode: str = "full"):
         ] + [
             ("graphshard.storm",
              lambda: _graphshard_row("graphshard.storm")),
+            # the one-kernel megatick under the armed loop: the exact
+            # stream's drain dispatches the fused Pallas kernel
+            # (interpret mode here) — same site allowlist as every other
+            # stream row, so any fused-path host sync fails loudly
+            ("stream.exact.fused",
+             lambda: _stream_row("stream.exact.fused", "exact", "off",
+                                 kernel_engine="pallas", fused_tick="on")),
         ]
     return rows
 
