@@ -113,6 +113,19 @@ def _enable_compile_cache(platform: str) -> None:
         log(f"compilation cache unavailable: {type(exc).__name__}: {exc}")
 
 
+def fused_emulated(runner) -> bool:
+    """True when the runner's fused megatick dispatches run as
+    interpret-mode (CPU-emulated) Pallas — stamped into every JSON row
+    next to fused_tick so a CPU-gauge fused row can never be mistaken
+    for a TPU fused win while the tunnel stays dead (TPU-blind since
+    r03). False whenever fused_tick resolved "off" (nothing fused ran)
+    or the kernels compiled for real hardware."""
+    if getattr(runner, "fused", "off") != "on":
+        return False
+    kern = getattr(runner, "kernel", runner)
+    return bool(getattr(kern, "_pl_interpret", False))
+
+
 def _parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="bench")
     p.add_argument("--nodes", type=int, default=1024)
@@ -182,6 +195,18 @@ def _parser() -> argparse.ArgumentParser:
                         "(megatick.resolve_fused_tick). Bit-identical "
                         "results; the JSON row's fused_tick field records "
                         "the RESOLUTION ('on'/'off')")
+    p.add_argument("--fused-tile", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="tiled-state layout of the fused megatick "
+                        "(kernels/megatick.resolve_fused_tile): 'on' = "
+                        "stream the [E, C] ring planes HBM->VMEM per step "
+                        "so fused execution survives states past the "
+                        "12 MB VMEM budget, 'off' = rings stay in the "
+                        "VMEM carry (refusing shapes that overflow), "
+                        "'auto' (default) = tile exactly when the "
+                        "resident layout would not fit. Bit-identical "
+                        "results; the JSON row's fused_tile field records "
+                        "the RESOLUTION")
     p.add_argument("--fused-block-edges", type=int, default=0,
                    help="fault-plane DMA block width for the fused "
                         "megatick's double-buffered HBM->VMEM edge-mask "
@@ -543,7 +568,8 @@ def run_worker(args) -> int:
                                queue_engine=args.queue_engine,
                                kernel_engine=args.kernel_engine, trace=trace,
                                fused_tick=args.fused_tick,
-                               fused_block_edges=args.fused_block_edges)
+                               fused_block_edges=args.fused_block_edges,
+                               fused_tile=args.fused_tile)
         topo = runner.topo
         log(f"graph: {topo.n} nodes, {topo.e} edges, max out-degree "
             f"{topo.d}; queue_capacity={cfg.queue_capacity}")
@@ -666,7 +692,8 @@ def run_worker(args) -> int:
                              queue_engine=args.queue_engine,
                              kernel_engine=args.kernel_engine,
                              fused_tick=args.fused_tick,
-                             fused_block_edges=args.fused_block_edges)
+                             fused_block_edges=args.fused_block_edges,
+                               fused_tile=args.fused_tile)
         fmtb = base.prepare_storm(prog)
         fb = base.run_storm(base.init_batch_device(formats=fmtb), prog)
         jax.block_until_ready(fb)
@@ -705,6 +732,11 @@ def run_worker(args) -> int:
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
         "fused_tick": runner.fused,
+        "fused_tile": runner.fused_tile,
+        # interpret-mode honesty: True means the fused kernels ran as
+        # CPU-emulated Pallas (TPU-blind since r03) — a gauge row, not a
+        # TPU fused win
+        "fused_emulated": fused_emulated(runner),
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -835,7 +867,8 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                            queue_engine=args.queue_engine,
                            kernel_engine=args.kernel_engine, trace=trace,
                            fused_tick=args.fused_tick,
-                           fused_block_edges=args.fused_block_edges)
+                           fused_block_edges=args.fused_block_edges,
+                               fused_tile=args.fused_tile)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
                        tail_alpha=1.1, max_phases=max(args.phases, 8),
@@ -903,6 +936,11 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
         "fused_tick": runner.fused,
+        "fused_tile": runner.fused_tile,
+        # interpret-mode honesty: True means the fused kernels ran as
+        # CPU-emulated Pallas (TPU-blind since r03) — a gauge row, not a
+        # TPU fused win
+        "fused_emulated": fused_emulated(runner),
         "graph": args.graph,
         "nodes": args.nodes,
         "batch": args.batch,
@@ -943,6 +981,7 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
                                     kernel_engine=args.kernel_engine,
                                     fused_tick=args.fused_tick,
                                     fused_block_edges=args.fused_block_edges,
+                                    fused_tile=args.fused_tile,
                                     trace=trace, memo=args.memo)
 
         def drive_memo():
@@ -1038,7 +1077,8 @@ def run_serve_worker(args, dev, spec, cfg) -> int:
                              queue_engine=args.queue_engine,
                              kernel_engine=args.kernel_engine,
                              fused_tick=args.fused_tick,
-                             fused_block_edges=args.fused_block_edges)
+                             fused_block_edges=args.fused_block_edges,
+                               fused_tile=args.fused_tile)
 
     cache_dir = tempfile.mkdtemp(prefix="clsim-serve-exec-")
 
@@ -1181,6 +1221,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                 comm_engine=args.comm_engine,
                                 kernel_engine=args.kernel_engine,
                                 fused_tick=args.fused_tick,
+                                fused_tile=args.fused_tile,
                                 megatick=args.megatick)
     topo = runner.topo
     log(f"graphshard: {topo.n} nodes / {args.graphshard} shards "
@@ -1223,6 +1264,7 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
                                     comm_engine=args.comm_engine,
                                     kernel_engine=args.kernel_engine,
                                     fused_tick=args.fused_tick,
+                                    fused_tile=args.fused_tile,
                                     megatick=args.megatick)
 
     times, ticks_seen = [], []
@@ -1260,6 +1302,8 @@ def run_graphshard_worker(args, dev, spec, cfg) -> int:
         "queue_engine": runner.queue_engine,
         "kernel_engine": runner.kernel_engine,
         "fused_tick": runner.fused,
+        "fused_tile": runner.fused_tile,
+        "fused_emulated": fused_emulated(runner),
         "comm_engine": runner.comm_engine,
         "megatick": runner.megatick,
         # analytic per-shard per-tick bytes for both engines at THIS

@@ -52,11 +52,21 @@ ENGINE_KNOBS = {
     # executes the whole K-tick run_ticks/drain scan inside a single
     # VMEM-resident Pallas kernel whenever it applies (kernel_engine
     # resolved to pallas, megatick > 1, ring markers, cascade/wave,
-    # supervisor and recorder off, working set within the VMEM budget)
-    # and falls back to the PR 9 split kernels otherwise; "on" raises on
-    # the first unmet requirement instead of silently splitting; "off"
-    # always splits. Bit-identical every way.
+    # working set within the VMEM budget — resident or tiled; the
+    # supervisor/recorder refusals are lifted, both trace in-kernel) and
+    # falls back to the PR 9 split kernels otherwise; "on" raises naming
+    # ALL unmet requirements instead of silently splitting; "off" always
+    # splits. Bit-identical every way.
     "fused_tick": ("auto", "on", "off"),
+    # tiled megatick state (kernels/megatick.resolve_fused_tile): "auto"
+    # streams the [E, C] ring planes HBM->VMEM in double-buffered edge
+    # blocks exactly when the fused kernel's resident working set
+    # overflows the VMEM budget — the shapes that used to silently fall
+    # back to the split path — and keeps everything resident otherwise;
+    # "on"/"off" force the layout (the differential tests pin
+    # tiled==resident bit-identity that way). Moot when fused_tick
+    # resolves "off".
+    "fused_tile": ("auto", "on", "off"),
 }
 
 
@@ -139,6 +149,13 @@ class SimConfig:
     # kwargs override per-instance; bit-identical either way
     # (tests/test_megatick_fused.py).
     fused_tick: str = "auto"
+    # Tiled megatick state layout (kernels/megatick.resolve_fused_tile):
+    # "auto" keeps the fused kernel's [E, C] ring-queue planes in HBM and
+    # streams them through the double-buffered block pipeline whenever
+    # the resident working set would overflow the VMEM budget, unlocking
+    # fused execution on graphs far past it; "on"/"off" force the
+    # layout. Bit-identical either way (tests/test_megatick_tiled.py).
+    fused_tile: str = "auto"
     # Snapshot supervisor (ops/tick.TickKernel._supervise): with
     # snapshot_timeout > 0, a started snapshot that has not completed
     # within that many ticks of its (re-)initiation is aborted IN TRACE —
@@ -188,7 +205,8 @@ class SimConfig:
             raise ValueError("count_dtype must be 'auto', 'bfloat16' or 'float32'")
         if self.reduce_mode not in ("auto", "matmul", "segsum"):
             raise ValueError("reduce_mode must be 'auto', 'matmul' or 'segsum'")
-        for knob in ("comm_engine", "kernel_engine", "fused_tick"):
+        for knob in ("comm_engine", "kernel_engine", "fused_tick",
+                     "fused_tile"):
             allowed = ENGINE_KNOBS[knob]
             if getattr(self, knob) not in allowed:
                 raise ValueError(

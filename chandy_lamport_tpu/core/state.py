@@ -334,6 +334,25 @@ class DenseState(NamedTuple):
       marker traffic — the dense per-tick [E, C] rewrite was >50% of
       sync-tick time on TPU, and the former [E, C] sequence plane was
       another whole ring array of traffic.
+
+    **Tiled-megatick block layout** (kernels/megatick.py, fused_tile).
+    The [E, C] ring planes dominate the working set (C is sized to the
+    workload's worst-case backlog), so the TILED fused kernel evicts
+    exactly them from the VMEM carry: ``q_meta``/``q_data`` ride as HBM
+    operands reshaped [RNB, REB, C] (plan_edge_blocks; ring slots
+    contiguous last, so one block = one DMA descriptor), streamed
+    through the same 2-slot double-buffered async-copy pipeline as the
+    fault planes, once per kernel step. Inside the kernel the carry's
+    q_meta/q_data slots are REPURPOSED: q_meta [2, A+1, E] holds the
+    step's deferred-append buffers (rows :A: ring column + packed meta
+    per append ordinal; A = megatick.ring_append_slots, the per-edge
+    per-tick append census) and the pre-extracted ring-head row (row A:
+    head_meta/head_data), q_data [A, E] the append payloads. Every
+    other plane — all [N], [S, N], [S, E], [L, E] node/bookkeeping
+    state — stays VMEM-resident; q_head/q_len remain live [E] vectors,
+    so pop/route/eligibility math never touches the streamed blocks.
+    Outside the kernel the DenseState shapes above are unchanged — the
+    repurposing exists only between pallas_call entry and exit.
     """
 
     time: Any          # i32 []

@@ -45,6 +45,44 @@ tick body's intermediates) — ``plan_edge_blocks`` / ``fused_vmem_bytes``
 below are that arithmetic, and ``resolve_fused_tick`` is the single
 gate deciding fused vs split (the ``fused_tick`` ENGINE_KNOBS row).
 
+Tiled state: ring planes past the VMEM ceiling
+----------------------------------------------
+The carry's dominant planes are the [E, C] ring queues (``q_meta`` /
+``q_data`` — 8·E·C bytes of the working set), and a graph whose rings
+alone overflow ``FUSED_VMEM_BUDGET`` used to silently fall back to the
+split path. The ``fused_tile`` knob (``resolve_fused_tile``) moves the
+rings OUT of the VMEM carry: they stay in HBM (``pltpu.ANY`` operands)
+and stream through the same double-buffered async-copy pipeline as the
+fault planes, in ``plan_edge_blocks`` edge blocks of [EB, C] — while
+every [N]-node and [E]-vector plane stays VMEM-resident. Per step the
+kernel needs the rings for exactly two things, and both tile:
+
+  heads    ``_head_fields`` reads slot ``q_head[e]`` of every edge once
+           per tick (before any in-tick write can land on a head slot —
+           supervisor re-initiations carry receive times > time, so a
+           pre-extracted head is never selected stale). The head gather
+           for step j+1 rides the SAME block pass as step j's commit,
+           so rings are read once, not twice, per step; step 0's heads
+           are gathered outside the kernel (``ring_heads``).
+  appends  ``_append_rows`` writes at most ``ring_append_slots`` rows
+           per edge per tick (bounded by the marker-broadcast /
+           supervisor / fault-dup census below). The tick body defers
+           them into dense [A, E] pos/meta/data planes riding the carry
+           in place of the ring planes (``_append_rows_deferred``), and
+           ``RingStream.commit_and_heads`` applies them block-by-block
+           in ordinal order — a read-modify-write pass whose write-back
+           DMA overlaps the next block's load. Ordinal order preserves
+           the eager path's write order (overflow wraps clobber
+           identically), and q_len/q_head/error stay eagerly updated on
+           the resident [E] vectors, so the tick is bit-identical by
+           construction, exactly like the resident path.
+
+A quiet/condition-false step commits an all-inactive buffer — the block
+pass rewrites identical bytes — so the DMA schedule is unconditional
+and uniform across the scan (no copies inside ``lax.cond`` branches).
+Tiling also lifts the old supervisor/recorder refusals: both are masked
+lane ops over resident planes and simply trace with the stock tick.
+
 Off-TPU everything runs under ``interpret=True`` like the PR 9 kernels,
 so CPU tier-1 exercises the fused body, the DMA pipeline included.
 """
@@ -90,13 +128,61 @@ def pytree_bytes(tree) -> int:
                if hasattr(x, "dtype"))
 
 
+def ring_append_slots(*, max_snapshots: int, max_in_degree: int,
+                      timeout_armed: bool, every_armed: bool,
+                      faulted: bool) -> int:
+    """A — the per-edge, per-tick ring-append bound the tiled kernel's
+    deferred-append planes are sized to. Census of every in-tick
+    ``_append_rows`` caller (all appends on edge e are broadcasts by
+    src(e), plus the fault dup):
+
+      marker fold / waves   a node first-receives at most min(S, in_deg)
+                            distinct snapshot ids in one tick (one
+                            delivery per in-edge per tick), each
+                            broadcasting once on every out-edge;
+      supervisor retries    _sup_reinitiate_ring re-broadcasts per
+                            retried slot — all S slots can retry in one
+                            tick with one initiator (+S, timeout armed);
+      snapshot daemon       one _inject_snapshot per tick (+1, armed);
+      fault duplication     the final dup re-append delivers at most one
+                            duplicate per edge per tick (+1, faulted).
+
+    Undersizing would drop appends silently, so _append_rows_deferred
+    also flags ERR_QUEUE_OVERFLOW if a cursor ever passes A — a bound
+    violation is loud, never corrupt."""
+    a = min(int(max_snapshots), max(int(max_in_degree), 1))
+    if timeout_armed:
+        a += int(max_snapshots)
+    if every_armed:
+        a += 1
+    if faulted:
+        a += 1
+    return max(a, 1)
+
+
 def fused_vmem_bytes(state_bytes: int, e: int, n: int, length: int,
-                     faulted: bool, block_edges: int = 0) -> int:
+                     faulted: bool, block_edges: int = 0, *,
+                     tiled: bool = False, queue_capacity: int = 0,
+                     append_slots: int = 0) -> int:
     """The fused kernel's resident working set: the carry (state + loop
     scalars) + the double-buffered edge-plane scratch (2 slots x 8 rows
     x NB·EB i32) + the K-resident node plane (length x 2 x N i32).
-    Fault-free kernels stream nothing — carry only."""
+    Fault-free kernels stream nothing — carry only.
+
+    ``tiled=True`` is the ring-streaming layout (module docstring): the
+    [E, C] ring planes leave the carry for HBM, replaced by the [A, E]
+    deferred-append pos/meta/data planes (``append_slots`` = A,
+    ring_append_slots) and the two [E] head vectors, plus the
+    double-buffered 2-slot x 2-plane x [EB, C] ring scratch."""
     total = state_bytes + 64        # + packed loop scalars
+    if tiled:
+        if queue_capacity <= 0:
+            raise ValueError("tiled working set needs queue_capacity")
+        nb, eb = plan_edge_blocks(e, block_edges)
+        total -= 2 * e * queue_capacity * 4      # rings leave the carry
+        total += 2 * 2 * eb * queue_capacity * 4  # ring DMA scratch
+        total += 3 * max(int(append_slots), 1) * e * 4  # deferred appends
+        total += 2 * e * 4                        # head_meta/head_data
     if faulted:
         nb, eb = plan_edge_blocks(e, block_edges)
         total += 2 * 8 * nb * eb * 4
@@ -108,6 +194,7 @@ def resolve_fused_tick(fused_tick: str, *, kernel_engine: str,
                        megatick: int, marker_mode: str, exact_impl: str,
                        supervised: bool, traced: bool,
                        vmem_bytes: int,
+                       tiled_vmem_bytes: int | None = None,
                        budget: int = FUSED_VMEM_BUDGET
                        ) -> tuple[str, str]:
     """Resolve the ``fused_tick`` knob (config.ENGINE_KNOBS) to a
@@ -119,46 +206,86 @@ def resolve_fused_tick(fused_tick: str, *, kernel_engine: str,
       * ring markers + cascade/wave — the vectorized exact formulations
         (the fold is the reference-literal specification form, and the
         split representation never runs the exact tick);
-      * supervisor and flight recorder off — both paths fall back to
-        the split kernels (documented contract: composition is via
-        fallback, bit-identical by the megatick differentials; the
-        fault adversary, by contrast, runs genuinely in-kernel via the
-        precomputed mask planes);
-      * the working set fits the VMEM budget (fused_vmem_bytes).
+      * the working set fits the VMEM budget (fused_vmem_bytes) — either
+        resident outright, or via the tiled ring-streaming layout:
+        ``tiled_vmem_bytes`` is the fused_vmem_bytes(tiled=True) figure
+        when ring streaming is available (None when ``fused_tile`` is
+        forced "off"), and an over-budget resident set is only a refusal
+        when the tiled set is over (or unavailable) too.
 
-    "on" RAISES on the first unmet requirement instead of silently
-    splitting — the explicit spelling is the CI/profiling override and
-    must never lie about what ran. "off" always splits."""
+    The historical supervisor/recorder refusals are LIFTED: both are
+    masked lane ops over VMEM-resident planes (the supervisor's deadline
+    arithmetic on the [S] window vectors, the recorder's event-ring
+    scatters on the [L, E] log) and trace inside the kernel with the
+    stock tick — the ``supervised`` / ``traced`` parameters remain in
+    the signature as documentation of that audit, not as gates.
+
+    "on" RAISES naming ALL unmet requirements at once instead of
+    silently splitting — the explicit spelling is the CI/profiling
+    override, must never lie about what ran, and must not make users
+    discover requirements one error at a time. "off" always splits;
+    "auto" reports the first unmet requirement as its reason."""
     if fused_tick not in ("auto", "on", "off"):
         raise ValueError(f"unknown fused_tick {fused_tick!r}")
     if fused_tick == "off":
         return "off", "fused_tick='off'"
-    why = None
+    del supervised, traced  # lifted refusals — see docstring
+    unmet = []
     if kernel_engine != "pallas":
-        why = (f"kernel_engine={kernel_engine!r} (the fused megatick is "
-               f"a Pallas kernel)")
-    elif megatick <= 1:
-        why = f"megatick={megatick} (nothing to fuse below K=2)"
-    elif marker_mode != "ring":
-        why = (f"marker_mode={marker_mode!r} (the exact tick only runs "
-               f"on the ring representation)")
-    elif exact_impl not in ("cascade", "wave"):
-        why = (f"exact_impl={exact_impl!r} (the fold is the reference-"
-               f"literal specification form)")
-    elif supervised:
-        why = ("snapshot supervisor armed (supervised runs keep the "
-               "split kernels)")
-    elif traced:
-        why = ("flight recorder armed (traced runs keep the split "
-               "kernels)")
-    elif vmem_bytes > budget:
-        why = (f"working set {vmem_bytes} B exceeds the "
-               f"{budget} B VMEM budget")
-    if why is None:
+        unmet.append(f"kernel_engine={kernel_engine!r} (the fused "
+                     f"megatick is a Pallas kernel)")
+    if megatick <= 1:
+        unmet.append(f"megatick={megatick} (nothing to fuse below K=2)")
+    if marker_mode != "ring":
+        unmet.append(f"marker_mode={marker_mode!r} (the exact tick only "
+                     f"runs on the ring representation)")
+    if exact_impl not in ("cascade", "wave"):
+        unmet.append(f"exact_impl={exact_impl!r} (the fold is the "
+                     f"reference-literal specification form)")
+    if vmem_bytes > budget:
+        if tiled_vmem_bytes is None:
+            unmet.append(f"working set {vmem_bytes} B exceeds the "
+                         f"{budget} B VMEM budget and fused_tile='off' "
+                         f"forbids streaming the ring planes")
+        elif tiled_vmem_bytes > budget:
+            unmet.append(f"working set {vmem_bytes} B exceeds the "
+                         f"{budget} B VMEM budget even with the ring "
+                         f"planes streamed ({tiled_vmem_bytes} B tiled)")
+    if not unmet:
         return "on", "fused megatick engaged"
     if fused_tick == "on":
-        raise ValueError(f"fused_tick='on' impossible: {why}")
-    return "off", why
+        raise ValueError(
+            f"fused_tick='on' impossible — {len(unmet)} unmet "
+            f"requirement(s): " + "; ".join(unmet))
+    return "off", unmet[0]
+
+
+def resolve_fused_tile(fused_tile: str, *, fused: str, vmem_bytes: int,
+                       tiled_vmem_bytes: int,
+                       budget: int = FUSED_VMEM_BUDGET) -> tuple[str, str]:
+    """Resolve the ``fused_tile`` knob (config.ENGINE_KNOBS) to a
+    concrete ("on"|"off", reason) AFTER resolve_fused_tick: tiling is a
+    layout of the fused kernel, so it is "off" whenever the fused
+    megatick itself is. "auto" tiles exactly when the resident working
+    set overflows the budget (the shapes that used to silently refuse);
+    a set that fits stays fully VMEM-resident — tiling it would add ring
+    DMA traffic for nothing. Explicit "on"/"off" force the layout either
+    way (the differential tests pin tiled==resident bit-identity on
+    small shapes that way)."""
+    if fused_tile not in ("auto", "on", "off"):
+        raise ValueError(f"unknown fused_tile {fused_tile!r}")
+    if fused != "on":
+        return "off", "fused megatick off — no kernel to tile"
+    if fused_tile == "off":
+        return "off", "fused_tile='off'"
+    if fused_tile == "on":
+        return "on", "fused_tile='on'"
+    if vmem_bytes > budget:
+        return "on", (f"resident working set {vmem_bytes} B exceeds the "
+                      f"{budget} B VMEM budget — ring planes stream "
+                      f"({tiled_vmem_bytes} B resident tiled)")
+    return "off", (f"resident working set {vmem_bytes} B fits the "
+                   f"{budget} B VMEM budget — rings stay resident")
 
 
 def _pack_edge_plane(plane, nb: int, eb: int):
@@ -171,8 +298,140 @@ def _pack_edge_plane(plane, nb: int, eb: int):
     return jnp.transpose(plane.reshape(k, r, nb, eb), (0, 2, 1, 3))
 
 
+def _pack_ring_plane(plane, rnb: int, reb: int):
+    """[E, C] -> [RNB, REB, C] (zero-padded on E): the tiled ring DMA
+    layout — one block copy descriptor per edge block, ring slots
+    contiguous last. Pads are never written (deferred-append pos rows
+    are -1 there) so they stay zero across the whole scan."""
+    e, c = plane.shape
+    pad = rnb * reb - e
+    if pad:
+        plane = jnp.pad(plane, ((0, pad), (0, 0)))
+    return plane.reshape(rnb, reb, c)
+
+
+def ring_heads(q_meta, q_data, q_head):
+    """Outer-trace head gather: slot ``q_head[e]`` of each [E, C] ring
+    plane, via the same one-hot integer contraction the in-kernel block
+    pass uses, so step 0's pre-extracted heads are exact matches of the
+    heads steps 1..K-1 gather inside the kernel (integers: the one-hot
+    sum reproduces the slot value bit-for-bit)."""
+    c = q_meta.shape[-1]
+    hit = q_head[:, None] == jnp.arange(c, dtype=_i32)[None, :]
+    head_meta = jnp.sum(jnp.where(hit, q_meta, 0), axis=-1, dtype=_i32)
+    head_data = jnp.sum(jnp.where(hit, q_data, 0), axis=-1, dtype=_i32)
+    return head_meta, head_data
+
+
+class RingStream:
+    """The tiled ring-plane streamer living inside the fused kernel.
+
+    Owns the [RNB, REB, C] HBM output refs of ``q_meta``/``q_data`` (the
+    kernel copies the input rings into them once at entry, then the scan
+    mutates them in place through this class), the double-buffered
+    2-slot x 2-plane [REB, C] VMEM scratch, and one DMA semaphore pair
+    per (slot, plane) for loads and for write-backs.
+
+    ``commit_and_heads`` is the once-per-step block pass (module
+    docstring): per edge block it folds the step's [A, E] deferred
+    appends into the block in ordinal order, gathers the NEXT step's
+    ring heads from the modified block (reading rings exactly once per
+    step), and writes the block back — with the write-back DMA of block
+    b-1 overlapping block b+1's load. The schedule is hazard-checked:
+    block b computes out of slot b%2; before loading block b+1 into slot
+    (b+1)%2 the pass waits block b-1's write-back (same slot), so a slot
+    is never reloaded while its previous write-back is still draining;
+    the final drain waits the last two write-backs, so the next step's
+    loads always read fully-landed blocks.
+    """
+
+    def __init__(self, qm_ref, qd_ref, scratch, lsem, wsem, *, e: int,
+                 rnb: int, reb: int, c: int):
+        self.qm_ref = qm_ref
+        self.qd_ref = qd_ref
+        self.scratch = scratch
+        self.lsem = lsem
+        self.wsem = wsem
+        self.e = e
+        self.rnb = rnb
+        self.reb = reb
+        self.c = c
+
+    def _load(self, b: int, slot: int):
+        return [pltpu.make_async_copy(
+            ref.at[b], self.scratch.at[slot, p], self.lsem.at[slot, p])
+            for p, ref in enumerate((self.qm_ref, self.qd_ref))]
+
+    def _store(self, b: int, slot: int):
+        return [pltpu.make_async_copy(
+            self.scratch.at[slot, p], ref.at[b], self.wsem.at[slot, p])
+            for p, ref in enumerate((self.qm_ref, self.qd_ref))]
+
+    def _pad_rows(self, v, fill: int):
+        """[.., E] -> [.., RNB, REB]: the per-block view of an edge
+        vector/plane (pad rows get ``fill``; -1 for append positions so
+        pads never match a ring column, 0 for everything else)."""
+        pad = self.rnb * self.reb - self.e
+        if pad:
+            widths = [(0, 0)] * (v.ndim - 1) + [(0, pad)]
+            v = jnp.pad(v, widths, constant_values=fill)
+        return v.reshape(v.shape[:-1] + (self.rnb, self.reb))
+
+    def commit_and_heads(self, pos_buf, meta_buf, data_buf, q_head):
+        """Apply one step's deferred appends ([A, E] pos/meta/data
+        planes, pos < 0 = inactive slot) and return the next step's
+        (head_meta, head_data) [E] vectors, in one block pass."""
+        a = pos_buf.shape[0]
+        pos = self._pad_rows(jnp.asarray(pos_buf, _i32), -1)
+        meta = self._pad_rows(jnp.asarray(meta_buf, _i32), 0)
+        data = self._pad_rows(jnp.asarray(data_buf, _i32), 0)
+        qh = self._pad_rows(jnp.asarray(q_head, _i32), 0)
+        col = lax.broadcasted_iota(_i32, (self.reb, self.c), 1)
+        hm_parts, hd_parts = [], []
+        for cp in self._load(0, 0):
+            cp.start()
+        for b in range(self.rnb):
+            slot = b % 2
+            for cp in self._load(b, slot):
+                cp.wait()
+            qm_blk = self.scratch[slot, 0]
+            qd_blk = self.scratch[slot, 1]
+            # the step's appends, in ordinal (program) order — later
+            # ordinals clobber earlier ones exactly like the eager
+            # path's sequential writes (overflow wraps included)
+            for j in range(a):
+                pj = pos[j, b]
+                hit = (pj[:, None] == col) & (pj >= 0)[:, None]
+                qm_blk = jnp.where(hit, meta[j, b][:, None], qm_blk)
+                qd_blk = jnp.where(hit, data[j, b][:, None], qd_blk)
+            # next step's heads, from the block AS MODIFIED — one ring
+            # read per step, and the one-hot sum matches ring_heads
+            hit_h = qh[b][:, None] == col
+            hm_parts.append(jnp.sum(jnp.where(hit_h, qm_blk, 0), axis=-1,
+                                    dtype=_i32))
+            hd_parts.append(jnp.sum(jnp.where(hit_h, qd_blk, 0), axis=-1,
+                                    dtype=_i32))
+            self.scratch[slot, 0] = qm_blk
+            self.scratch[slot, 1] = qd_blk
+            for cp in self._store(b, slot):
+                cp.start()
+            if b + 1 < self.rnb:
+                if b >= 1:
+                    for cp in self._store(b - 1, (b + 1) % 2):
+                        cp.wait()
+                for cp in self._load(b + 1, (b + 1) % 2):
+                    cp.start()
+        for b in range(max(self.rnb - 2, 0), self.rnb):
+            for cp in self._store(b, b % 2):
+                cp.wait()
+        head_meta = jnp.concatenate(hm_parts)[:self.e]
+        head_data = jnp.concatenate(hd_parts)[:self.e]
+        return head_meta, head_data
+
+
 def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
-               interpret: bool, block_edges: int = 0, consts=None):
+               interpret: bool, block_edges: int = 0, consts=None,
+               ring=None):
     """Run ``length`` steps of ``step_fn`` inside ONE Pallas kernel with
     the whole ``carry`` pytree VMEM-resident between steps.
 
@@ -189,10 +448,19 @@ def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
     operands, are read once, and are handed to the step as a fourth
     argument, ``step_fn(carry, ep, aux, consts)``.
 
+    ``ring`` (optional ``(q_meta, q_data)`` pair of [E, C] i32 planes)
+    is the tiled-state layout (module docstring): both planes ride as
+    HBM (``pltpu.ANY``) operands AND outputs — the kernel DMA-copies the
+    inputs into the outputs once at entry, then mutates the outputs in
+    place through a ``RingStream`` handed to the step as a fifth
+    argument, ``step_fn(carry, ep, aux, consts, rs)``. The call then
+    returns ``(carry, (q_meta', q_data'))`` instead of just the carry.
+
     Zero-size carry leaves (representation planes the exact tick never
     touches — split-mode marker planes, a disarmed trace ring) bypass
     the kernel and are reattached verbatim: step_fn must not write them
-    (the resolve_fused_tick gate guarantees the recorder is off).
+    (a disarmed plane is zero-size exactly because its feature is off;
+    an ARMED trace ring is a live leaf and rides the carry normally).
     """
     leaves, treedef = jax.tree_util.tree_flatten(carry)
     live = [i for i, x in enumerate(leaves) if jnp.size(x) > 0]
@@ -209,12 +477,22 @@ def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
     if consts is not None:
         const_leaves, const_def = jax.tree_util.tree_flatten(consts)
         n_const = len(const_leaves)
-    e = nb = eb = 0
+    e = nb = eb = r = 0
     if edge_plane is not None:
         k, r, e = edge_plane.shape
         assert k == length
         nb, eb = plan_edge_blocks(e, block_edges)
         edge_plane = _pack_edge_plane(jnp.asarray(edge_plane, _i32), nb, eb)
+    re_ = rc = rnb = reb = 0
+    ring_ops = None
+    if ring is not None:
+        qm0, qd0 = ring
+        re_, rc = qm0.shape
+        rnb, reb = plan_edge_blocks(re_, block_edges)
+        ring_ops = [_pack_ring_plane(jnp.asarray(qm0, _i32), rnb, reb),
+                    _pack_ring_plane(jnp.asarray(qd0, _i32), rnb, reb)]
+        out_shape = out_shape + tuple(
+            jax.ShapeDtypeStruct((rnb, reb, rc), _i32) for _ in range(2))
 
     def unpack_carry(refs):
         vals = [ref[0] if s else ref[...] for ref, s in zip(refs, scalars)]
@@ -237,15 +515,22 @@ def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
         aux_vals = [a[...] for a in refs[n_in:n_in + n_aux]]
         cv = [c[...] for c in
               refs[n_in + n_aux:n_in + n_aux + n_const]]
-        ep_ref = (refs[n_in + n_aux + n_const]
-                  if edge_plane is not None else None)
-        out_refs = refs[len(refs) - len(ins):]
+        pos = n_in + n_aux + n_const
+        ep_ref = None
+        if edge_plane is not None:
+            ep_ref = refs[pos]
+            pos += 1
+        ring_in = refs[pos:pos + 2] if ring is not None else None
+        n_out = n_in + (2 if ring is not None else 0)
+        out_all = refs[len(refs) - n_out:]
+        out_refs = out_all[:n_in]
+        ring_out = out_all[n_in:]
 
         c0 = unpack_carry(in_refs)
         const_tree = (jax.tree_util.tree_unflatten(const_def, cv)
                       if consts is not None else None)
 
-        def body(c, j, ep_vmem):
+        def body(c, j, ep_vmem, rs):
             ep = None
             if ep_vmem is not None:
                 # [NB, R, EB] -> [R, E]: undo the block layout, drop pad
@@ -255,53 +540,86 @@ def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
             if aux_plane is not None:
                 ax = jax.tree_util.tree_unflatten(
                     aux_def, [a[j] for a in aux_vals])
+            if ring is not None:
+                return step_fn(c, ep, ax, const_tree, rs)
             if consts is not None:
                 return step_fn(c, ep, ax, const_tree)
             return step_fn(c, ep, ax)
 
-        if ep_ref is None:
+        if ep_ref is None and ring is None:
             def step(c, j):
-                return body(c, j, None), None
+                return body(c, j, None, None), None
 
             c, _ = lax.scan(step, c0, jnp.arange(length, dtype=_i32))
             pack_carry(c, out_refs)
             return
 
-        def inner(scratch, sem):
-            def copies(j, slot):
-                return [pltpu.make_async_copy(
-                    ep_ref.at[j, b], scratch.at[slot, b], sem.at[slot, b])
-                    for b in range(nb)]
+        def inner(ep_scratch=None, ep_sem=None, rg_scratch=None,
+                  rg_lsem=None, rg_wsem=None, rg_csem=None):
+            rs = None
+            if ring is not None:
+                # one HBM->HBM copy of each input ring into its output
+                # ref at kernel entry: the scan owns the output copy and
+                # mutates it in place via RingStream's block passes
+                cin = [pltpu.make_async_copy(ring_in[p], ring_out[p],
+                                             rg_csem.at[p])
+                       for p in range(2)]
+                for cp in cin:
+                    cp.start()
+                for cp in cin:
+                    cp.wait()
+                rs = RingStream(ring_out[0], ring_out[1], rg_scratch,
+                                rg_lsem, rg_wsem, e=re_, rnb=rnb,
+                                reb=reb, c=rc)
 
-            for cp in copies(jnp.int32(0), jnp.int32(0)):
-                cp.start()
+            if ep_ref is not None:
+                def copies(j, slot):
+                    return [pltpu.make_async_copy(
+                        ep_ref.at[j, b], ep_scratch.at[slot, b],
+                        ep_sem.at[slot, b])
+                        for b in range(nb)]
+
+                for cp in copies(jnp.int32(0), jnp.int32(0)):
+                    cp.start()
 
             def step(c, j):
-                slot = lax.rem(j, jnp.int32(2))
-                for cp in copies(j, slot):
-                    cp.wait()
-                # prefetch tick j+1 into the other slot while tick j
-                # executes (the last step re-fetches its own row: the
-                # copy is started so the post-scan drain stays uniform,
-                # its data is never read)
-                nxt = jnp.minimum(j + 1, length - 1)
-                for cp in copies(nxt, lax.rem(j + 1, jnp.int32(2))):
-                    cp.start()
-                return body(c, j, scratch[slot]), None
+                ep_vmem = None
+                if ep_ref is not None:
+                    slot = lax.rem(j, jnp.int32(2))
+                    for cp in copies(j, slot):
+                        cp.wait()
+                    # prefetch tick j+1 into the other slot while tick
+                    # j executes (the last step re-fetches its own row:
+                    # the copy is started so the post-scan drain stays
+                    # uniform, its data is never read)
+                    nxt = jnp.minimum(j + 1, length - 1)
+                    for cp in copies(nxt, lax.rem(j + 1, jnp.int32(2))):
+                        cp.start()
+                    ep_vmem = ep_scratch[slot]
+                return body(c, j, ep_vmem, rs), None
 
             c, _ = lax.scan(step, c0, jnp.arange(length, dtype=_i32))
-            for cp in copies(jnp.int32(length - 1),
-                             lax.rem(jnp.int32(length), jnp.int32(2))):
-                cp.wait()
+            if ep_ref is not None:
+                for cp in copies(jnp.int32(length - 1),
+                                 lax.rem(jnp.int32(length),
+                                         jnp.int32(2))):
+                    cp.wait()
             pack_carry(c, out_refs)
 
-        pl.run_scoped(
-            inner,
-            scratch=pltpu.VMEM((2, nb, r, eb), _i32),
-            sem=pltpu.SemaphoreType.DMA((2, nb)))
+        scopes = {}
+        if ep_ref is not None:
+            scopes["ep_scratch"] = pltpu.VMEM((2, nb, r, eb), _i32)
+            scopes["ep_sem"] = pltpu.SemaphoreType.DMA((2, nb))
+        if ring is not None:
+            scopes["rg_scratch"] = pltpu.VMEM((2, 2, reb, rc), _i32)
+            scopes["rg_lsem"] = pltpu.SemaphoreType.DMA((2, 2))
+            scopes["rg_wsem"] = pltpu.SemaphoreType.DMA((2, 2))
+            scopes["rg_csem"] = pltpu.SemaphoreType.DMA((2,))
+        pl.run_scoped(inner, **scopes)
 
     # carry + aux ride as ordinary whole-array VMEM operands; only the
-    # K-scaling edge plane stays in ANY (HBM) behind the DMA pipeline.
+    # K-scaling edge plane and the tiled ring planes stay in ANY (HBM)
+    # behind their DMA pipelines.
     operands = list(ins)
     if aux_plane is not None:
         operands += [jnp.asarray(a, _i32) for a in aux_leaves]
@@ -312,22 +630,40 @@ def fused_scan(step_fn, carry, edge_plane, aux_plane, *, length: int,
     if edge_plane is not None:
         operands.append(edge_plane)
         in_spec_list.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    call_kwargs = {}
+    if ring is not None:
+        operands += ring_ops
+        in_spec_list += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        call_kwargs["out_specs"] = tuple(
+            [pl.BlockSpec(memory_space=pltpu.VMEM)] * len(ins)
+            + [pl.BlockSpec(memory_space=pltpu.ANY)] * 2)
 
     outs = pl.pallas_call(
         kernel,
         in_specs=in_spec_list,
         out_shape=out_shape,
-        interpret=interpret)(*operands)
+        interpret=interpret,
+        **call_kwargs)(*operands)
     if not isinstance(outs, (tuple, list)):
         outs = (outs,)
+    ring_result = None
+    if ring is not None:
+        qm2, qd2 = outs[-2:]
+        outs = outs[:-2]
+        ring_result = (qm2.reshape(rnb * reb, rc)[:re_],
+                       qd2.reshape(rnb * reb, rc)[:re_])
     full = list(leaves)
     for x, i, s in zip(outs, live, scalars):
         full[i] = jnp.reshape(x, ()) if s else x
-    return jax.tree_util.tree_unflatten(treedef, full)
+    carry_out = jax.tree_util.tree_unflatten(treedef, full)
+    if ring is not None:
+        return carry_out, ring_result
+    return carry_out
 
 
 def hbm_round_trip_model(state_bytes: int, plane_bytes: int, length: int,
-                         fused: bool) -> int:
+                         fused: bool, *, ring_bytes: int = 0,
+                         tiled: bool = False) -> int:
     """Analytic HBM traffic of one K-tick dispatch — what a compiled TPU
     kernel would actually move, the metric the cost plane pins next to
     the backend-dependent ``bytes_accessed`` (interpret-mode Pallas
@@ -336,7 +672,20 @@ def hbm_round_trip_model(state_bytes: int, plane_bytes: int, length: int,
     the carry every tick (a deliberately conservative FLOOR — the real
     split path round-trips per STAGE, not per tick); the fused kernel
     reads the carry once, writes it once, and streams each fault-plane
-    row exactly once."""
+    row exactly once.
+
+    ``tiled`` is the ring-streaming layout: the non-ring carry still
+    round-trips once, but the [E, C] ring planes (``ring_bytes`` =
+    2·E·C·4) move per STEP — the entry copy-in reads + writes them once,
+    then every step's commit_and_heads block pass loads and writes back
+    every block once: ``2·ring·(K+1)`` ring bytes total. Tiled fused
+    traffic therefore grows with K through the ring term only — still
+    far below the split path's full-carry-per-tick round trip whenever
+    the rings don't utterly dominate the state, and the price paid for
+    running shapes the resident layout cannot hold at all."""
+    if fused and tiled:
+        return (2 * (state_bytes - ring_bytes) + plane_bytes
+                + 2 * ring_bytes * (max(length, 1) + 1))
     if fused:
         return 2 * state_bytes + plane_bytes
     return 2 * state_bytes * max(length, 1) + plane_bytes

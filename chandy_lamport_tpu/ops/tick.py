@@ -239,6 +239,29 @@ def window_update(s, started_se, stopped_se, rec_cnt):
     return out
 
 
+def _map_state(c, fn):
+    """Apply ``fn`` to every DenseState inside a (possibly nested) tuple
+    carry, leaving non-state leaves untouched. DenseState IS a tuple
+    (NamedTuple), so the isinstance order matters."""
+    if isinstance(c, DenseState):
+        return fn(c)
+    if isinstance(c, tuple):
+        return tuple(_map_state(x, fn) for x in c)
+    return c
+
+
+def _state_of(c):
+    """First DenseState inside a (possibly nested) tuple carry, or None."""
+    if isinstance(c, DenseState):
+        return c
+    if isinstance(c, tuple):
+        for x in c:
+            st = _state_of(x)
+            if st is not None:
+                return st
+    return None
+
+
 class TickKernel:
     """Jitted closures over a fixed (topology, config, delay sampler).
 
@@ -257,7 +280,8 @@ class TickKernel:
                  kernel_engine: str | None = None,
                  faults=None, quarantine: bool = False, trace=None,
                  fused_tick: str | None = None,
-                 fused_block_edges: int = 0):
+                 fused_block_edges: int = 0,
+                 fused_tile: str | None = None):
         """marker_mode selects the channel representation (DenseState
         docstring): "ring" = markers share the token ring buffers (required
         by the bit-exact scheduler, whose PRNG draw order is push order);
@@ -355,9 +379,22 @@ class TickKernel:
         ``self.fused`` holds the resolved "on"/"off" and
         ``self.fused_reason`` the reason. Bit-identical either way
         (tests/test_megatick_fused.py). fused_block_edges overrides the
-        edge-block width of the fault-plane DMA pipeline (0 = the
-        plan_edge_blocks default; tests shrink it to force multi-block
-        geometry on small graphs)."""
+        edge-block width of the fault-plane AND ring-plane DMA pipelines
+        (0 = the plan_edge_blocks default; tests shrink it to force
+        multi-block geometry on small graphs).
+
+        fused_tile ("auto"/"on"/"off", None defers to cfg.fused_tile)
+        selects the TILED fused-state layout (the megatick module
+        docstring): the [E, C] ring planes stay in HBM and stream
+        through the double-buffered block pipeline while every node
+        plane stays VMEM-resident — heads pre-extracted once per step,
+        appends deferred into [A, E] planes and committed block-by-block
+        — which is what lets resolve_fused_tick accept working sets far
+        past the VMEM budget. kernels.megatick.resolve_fused_tile is the
+        gate ("auto" tiles exactly when the resident set overflows);
+        ``self.fused_tile`` / ``self.fused_tile_reason`` hold the
+        resolution. Bit-identical either way
+        (tests/test_megatick_tiled.py)."""
         if marker_mode not in ("ring", "split"):
             raise ValueError(f"unknown marker_mode {marker_mode!r}")
         if (faults is not None and marker_mode == "ring"
@@ -506,8 +543,11 @@ class TickKernel:
 
         self.fused_tick = (cfg.fused_tick if fused_tick is None
                            else fused_tick)
+        self.fused_tile_knob = (cfg.fused_tile if fused_tile is None
+                                else fused_tile)
         self.fused_block_edges = int(fused_block_edges)
-        vmem = 0
+        vmem = tiled_vmem = 0
+        self._ring_append_slots = 0
         if self.fused_tick != "off":
             # working-set arithmetic is only needed once the cheap gates
             # can pass; init_state is host-side numpy, built transiently
@@ -518,20 +558,43 @@ class TickKernel:
             vmem = plk_megatick.fused_vmem_bytes(
                 self._state_bytes, topo.e, topo.n, self.megatick,
                 faults is not None, self.fused_block_edges)
+            # the tiled layout's deferred-append bound and working set —
+            # what lets the budget arm accept shapes whose rings blow
+            # the resident figure (megatick.ring_append_slots census)
+            self._ring_append_slots = plk_megatick.ring_append_slots(
+                max_snapshots=cfg.max_snapshots,
+                max_in_degree=int(_np.max(_np.asarray(topo.in_degree))),
+                timeout_armed=cfg.snapshot_timeout > 0,
+                every_armed=cfg.snapshot_every > 0,
+                faulted=faults is not None)
+            tiled_vmem = plk_megatick.fused_vmem_bytes(
+                self._state_bytes, topo.e, topo.n, self.megatick,
+                faults is not None, self.fused_block_edges,
+                tiled=True, queue_capacity=cfg.queue_capacity,
+                append_slots=self._ring_append_slots)
         self.fused, self.fused_reason = plk_megatick.resolve_fused_tick(
             self.fused_tick,
             kernel_engine=self.kernel_engine, megatick=self.megatick,
             marker_mode=marker_mode, exact_impl=exact_impl,
-            supervised=self._sup, traced=self._trace_on, vmem_bytes=vmem)
+            supervised=self._sup, traced=self._trace_on, vmem_bytes=vmem,
+            tiled_vmem_bytes=(None if self.fused_tile_knob == "off"
+                              else tiled_vmem))
+        self.fused_tile, self.fused_tile_reason = (
+            plk_megatick.resolve_fused_tile(
+                self.fused_tile_knob, fused=self.fused, vmem_bytes=vmem,
+                tiled_vmem_bytes=tiled_vmem))
         if self.fused == "on":
             # the tick body traced INSIDE the fused kernel: the same
             # TickKernel, pinned to the stock-XLA formulations (no nested
             # pallas_call) with segsum reductions (no [N, E] matmul
             # constants resident in VMEM — integer-exact, bit-identical),
             # the queue addressing inherited from the outer resolution.
-            # Everything else (faults, quarantine, formulation) matches,
-            # so _exact_tick's jaxpr is the one the split paths are
-            # differentially pinned against.
+            # Everything else (faults, quarantine, formulation, the
+            # supervisor via the shared cfg, the flight recorder via the
+            # shared trace handle — both masked lane ops over resident
+            # planes, traced in-kernel) matches, so _exact_tick's jaxpr
+            # is the one the split paths are differentially pinned
+            # against.
             self._fused_inner = TickKernel(
                 topo,
                 dataclasses.replace(cfg, reduce_mode="segsum",
@@ -540,7 +603,7 @@ class TickKernel:
                 delay, marker_mode="ring", exact_impl=exact_impl,
                 megatick=1, queue_engine=self.queue_engine,
                 kernel_engine="xla", faults=faults, quarantine=quarantine,
-                trace=None)
+                trace=trace)
         if marker_mode == "split":
             # a split-mode kernel carries markers in the [S, E] pending
             # planes, not the rings, so no bit-exact formulation can run on
@@ -932,7 +995,7 @@ class TickKernel:
 
         def body(carry):
             s, m = carry
-            sid = jnp.argmax(m)
+            sid = jnp.argmax(m).astype(_i32)
             node = jnp.clip(s.snap_initiator[sid], 0, self.topo.n - 1)
             s = self._create_local(s, sid, node, jnp.int32(-1))
             s = self._broadcast_markers(s, node, sid)
@@ -998,13 +1061,36 @@ class TickKernel:
 
     # ---- queue primitives ------------------------------------------------
 
+    # tiled-megatick ring indirection (kernels/megatick module docstring):
+    # while the TILED fused kernel traces a tick, this flag reroutes the
+    # tick's only two ring-content touch points — the [E, C] rings live
+    # in HBM, and the state carry's q_meta/q_data slots are repurposed as
+    # q_meta [2, A+1, E] (rows :A = deferred-append (pos, meta) buffers,
+    # row A = the step's pre-extracted (head_meta, head_data) vectors)
+    # and q_data [A, E] (append payloads). _head_fields reads the head
+    # row, _append_rows defers into the buffer rows AND patches the head
+    # row for head-slot appends. The heads ride the STATE — not Python
+    # side-state — so the patch flows through lax.cond/while_loop traces
+    # (the supervisor's re-initiation appends live inside them) as plain
+    # dataflow. False (always, outside a tiled trace) compiles the
+    # indirection away entirely.
+    _ring_defer = False
+
     def _head_fields(self, s: DenseState):
         """Every ring head's (rtime, is_marker, data), addressed by
         ``queue_engine``: ONE [E] gather per packed plane
         (``take_along_axis`` at q_head), or the legacy [E, C] one-hot mask
         reductions. Heads of empty queues read their stale slot either way
         (callers gate on q_len > 0), so the engines are bit-identical.
-        kernel_engine="pallas" overrides both with the fused VMEM pass."""
+        kernel_engine="pallas" overrides both with the fused VMEM pass;
+        a tiled fused trace (``_ring_defer``) serves the pre-extracted
+        head row of the repurposed q_meta instead — gathered by the
+        previous step's in-kernel commit pass (or ring_heads outside the
+        kernel for step 0) and patched by any same-tick head-slot append,
+        so the values are exactly what a live read here would return."""
+        if self._ring_defer:
+            head_meta, head_data = s.q_meta[0, -1], s.q_meta[1, -1]
+            return meta_rtime(head_meta), meta_marker(head_meta), head_data
         if self.kernel_engine == "pallas":
             return plk_queue.head_fields(s.q_meta, s.q_data, s.q_head,
                                          interpret=self._pl_interpret)
@@ -1038,6 +1124,9 @@ class TickKernel:
         rt_e = jnp.asarray(rt_e, _i32)
         data_e = jnp.broadcast_to(jnp.asarray(data_e, _i32), active.shape)
         meta_e = pack_meta(rt_e, mk_e)
+        if self._ring_defer:
+            return self._append_rows_deferred(s, active, rt_e, meta_e,
+                                              data_e)
         if self.kernel_engine == "pallas":
             q_meta, q_data, err = plk_queue.append_rows(
                 s.q_meta, s.q_data, s.q_head, s.q_len, s.tok_pushed,
@@ -1075,6 +1164,70 @@ class TickKernel:
         return s._replace(
             q_meta=q_meta,
             q_data=q_data,
+            q_len=s.q_len + active.astype(_i32),
+            tok_pushed=s.tok_pushed + active.astype(_i32),
+            error=s.error | err,
+        )
+
+    def _append_rows_deferred(self, s: DenseState, active, rt_e, meta_e,
+                              data_e) -> DenseState:
+        """_append_rows for a TILED fused trace (``_ring_defer`` armed):
+        the [E, C] rings live in HBM, so instead of scattering into them
+        the append is recorded into the dense [A, E] buffer planes riding
+        the carry in ``q_meta``/``q_data``'s place — ``q_meta[0]`` the
+        target ring column per ordinal (−1 = unused slot), ``q_meta[1]``
+        the packed meta word, ``q_data`` the payload — and the in-kernel
+        commit pass (megatick.RingStream.commit_and_heads) replays them
+        against the streamed blocks in ordinal order at step end, which
+        reproduces the eager path's write order exactly (overflow-wrap
+        clobbers included). Everything ELSE is the eager append verbatim:
+        the error folds, the q_len/tok_pushed bumps, the captured ring
+        column (q_head/q_len are live [E] vectors in the carry).
+
+        Two invariants keep this bit-identical:
+          * the ordinal cursor is the count of used buffer slots — NOT
+            derived from q_len deltas, which supervisor appends that
+            precede the tick's pops would skew;
+          * an append landing on an edge's HEAD slot (empty queue, or a
+            capacity wrap — pos == q_head either way) also patches the
+            head row, so the single head read at _select_and_pop sees
+            exactly what a live ring read would (the supervisor appends
+            before selection; stale pre-extracted content would
+            otherwise leak into the eligibility math). The patch is a
+            state write, so it threads through the supervisor's
+            lax.cond/while_loop wrappers as ordinary carry dataflow.
+        A cursor past A means ring_append_slots' census was violated —
+        flagged ERR_QUEUE_OVERFLOW (loud), never silently dropped."""
+        C = self.cfg.queue_capacity
+        meta_e = jnp.broadcast_to(meta_e, active.shape)
+        err = (jnp.any(active & (s.q_len >= C)).astype(_i32)
+               * ERR_QUEUE_OVERFLOW
+               | (jnp.any(active & (s.tok_pushed >= self._key_limit))
+                  | jnp.any(active & (rt_e >= RTIME_PACK_LIMIT))
+                  ).astype(_i32) * ERR_VALUE_OVERFLOW)
+        pos = (s.q_head + s.q_len) % C
+        buf_pos, buf_meta = s.q_meta[0, :-1], s.q_meta[1, :-1]     # [A, E]
+        head_meta, head_data = s.q_meta[0, -1], s.q_meta[1, -1]    # [E]
+        buf_data = s.q_data
+        a = buf_pos.shape[0]
+        cursor = jnp.sum((buf_pos >= 0).astype(_i32), axis=0,
+                         dtype=_i32)                               # [E]
+        err = err | (jnp.any(active & (cursor >= a)).astype(_i32)
+                     * ERR_QUEUE_OVERFLOW)
+        hit = active[None, :] & (jnp.arange(a, dtype=_i32)[:, None]
+                                 == cursor[None, :])               # [A, E]
+        buf_pos = jnp.where(hit, pos[None, :], buf_pos)
+        buf_meta = jnp.where(hit, meta_e[None, :], buf_meta)
+        buf_data = jnp.where(hit, data_e[None, :], buf_data)
+        at_head = active & (pos == s.q_head)
+        head_meta = jnp.where(at_head, meta_e, head_meta)
+        head_data = jnp.where(at_head, data_e, head_data)
+        q_meta = jnp.concatenate(
+            [jnp.stack([buf_pos, buf_meta]),
+             jnp.stack([head_meta, head_data])[:, None, :]], axis=1)
+        return s._replace(
+            q_meta=q_meta,
+            q_data=buf_data,
             q_len=s.q_len + active.astype(_i32),
             tok_pushed=s.tok_pushed + active.astype(_i32),
             error=s.error | err,
@@ -2090,8 +2243,21 @@ class TickKernel:
         kernel operands. A Pallas body cannot close over arrays, so the
         inner kernel's jax.Array attributes are swapped for their
         operand-read values for the duration of the in-kernel trace and
-        restored after (the swap only exists while fused_scan traces)."""
-        from chandy_lamport_tpu.kernels.megatick import fused_scan
+        restored after (the swap only exists while fused_scan traces).
+
+        fused_tile="on" reroutes the [E, C] ring planes: they leave the
+        VMEM carry for HBM ANY operands, the carry's q_meta/q_data slots
+        are repurposed as dense [A, E] append buffers (A =
+        ring_append_slots), per-step appends go through
+        _append_rows_deferred, and each step ends with one streamed
+        double-buffered block pass (RingStream.commit_and_heads) that
+        replays the appends in ordinal order AND gathers the next step's
+        head rows — the rings are read/written once per step, never
+        resident. Step 0's heads are gathered outside the kernel
+        (megatick.ring_heads). The commit pass runs unconditionally every
+        step: a quiet (bumped) step commits an all-inactive buffer, which
+        writes back identical bytes, so the cond stays DMA-free."""
+        from chandy_lamport_tpu.kernels.megatick import fused_scan, ring_heads
 
         fm_e = fm_n = None
         if self.faults is not None:
@@ -2100,28 +2266,74 @@ class TickKernel:
         cvals = {n: v for n, v in sorted(vars(inner).items())
                  if isinstance(v, jax.Array)}
 
-        def step_c(c, ep, ax, cv):
-            for n, v in cv.items():
-                setattr(inner, n, v)
+        if self.fused_tile != "on":
+            def step_c(c, ep, ax, cv):
+                for n, v in cv.items():
+                    setattr(inner, n, v)
+                try:
+                    return step(c, ep, ax)
+                finally:
+                    # restore BEFORE the in-kernel trace is finalized: the
+                    # kernel jaxpr is leak-checked the moment pallas_call
+                    # finishes tracing, which is before the outer finally
+                    # below runs — operand tracers left on ``inner`` there
+                    # trip jax.checking_leaks (the runtime sentry's regime)
+                    for n, v in cvals.items():
+                        setattr(inner, n, v)
+
             try:
-                return step(c, ep, ax)
+                return fused_scan(step_c, carry, fm_e, fm_n, length=length,
+                                  interpret=self._pl_interpret,
+                                  block_edges=self.fused_block_edges,
+                                  consts=cvals)
             finally:
-                # restore BEFORE the in-kernel trace is finalized: the
-                # kernel jaxpr is leak-checked the moment pallas_call
-                # finishes tracing, which is before the outer finally
-                # below runs — operand tracers left on ``inner`` there
-                # trip jax.checking_leaks (the runtime sentry's regime)
                 for n, v in cvals.items():
                     setattr(inner, n, v)
 
+        A, E = self._ring_append_slots, self.topo.e
+
+        def swap_bufs(c, head_meta, head_data):
+            # fresh all-inactive append buffers + this step's head row
+            # (repurposed q_meta layout — see the _ring_defer comment)
+            bm = jnp.concatenate(
+                [jnp.stack([jnp.full((A, E), -1, _i32),
+                            jnp.zeros((A, E), _i32)]),
+                 jnp.stack([head_meta, head_data])[:, None, :]], axis=1)
+            bd = jnp.zeros((A, E), _i32)
+            return _map_state(c, lambda t: t._replace(q_meta=bm, q_data=bd))
+
+        ring = (jnp.asarray(s.q_meta, _i32), jnp.asarray(s.q_data, _i32))
+        hm0, hd0 = ring_heads(ring[0], ring[1], s.q_head)
+        kcarry = swap_bufs(carry, hm0, hd0)
+
+        def step_t(c, ep, ax, cv, rs):
+            for n, v in cv.items():
+                setattr(inner, n, v)
+            inner._ring_defer = True
+            try:
+                c_out = step(c, ep, ax)
+            finally:
+                inner._ring_defer = False
+                for n, v in cvals.items():
+                    setattr(inner, n, v)
+            st = _state_of(c_out)
+            hm2, hd2 = rs.commit_and_heads(st.q_meta[0, :-1],
+                                           st.q_meta[1, :-1],
+                                           st.q_data, st.q_head)
+            return swap_bufs(c_out, hm2, hd2)
+
         try:
-            return fused_scan(step_c, carry, fm_e, fm_n, length=length,
-                              interpret=self._pl_interpret,
-                              block_edges=self.fused_block_edges,
-                              consts=cvals)
+            c_out, (qm2, qd2) = fused_scan(
+                step_t, kcarry, fm_e, fm_n, length=length,
+                interpret=self._pl_interpret,
+                block_edges=self.fused_block_edges,
+                consts=cvals, ring=ring)
         finally:
+            inner._ring_defer = False
             for n, v in cvals.items():
                 setattr(inner, n, v)
+        return _map_state(c_out,
+                          lambda t: t._replace(q_meta=qm2, q_data=qd2))
 
     def _fused_mega_ticks(self, s: DenseState, halted, bump) -> DenseState:
         """One fused megatick for the run_ticks loop: K ticks in one
@@ -2326,12 +2538,14 @@ class TickKernel:
         path) lets a ``fused == 'on'`` kernel execute the K-tick drain
         body and the flush loop inside the one-kernel megatick. The
         drain condition is monotone non-increasing within a megatick
-        (started/snap_failed are fixed with the supervisor off — the
-        fused gate guarantees that — completed only grows, error is
-        sticky, and a condition-false step freezes time), so real ticks
-        form a step prefix and the precomputed fault planes' row/time
-        correspondence holds; the traced ``limit`` rides in the kernel
-        carry rather than being closed over."""
+        for the only reason that matters: a condition-false step is the
+        IDENTITY (no pops, no supervisor, no time advance), so once the
+        condition goes false it can never flip back true — supervisor
+        armed or not (a supervisor tick can flip ``pending`` either way,
+        but only on steps where the condition was already true). Real
+        ticks therefore form a step prefix and the precomputed fault
+        planes' row/time correspondence holds; the traced ``limit``
+        rides in the kernel carry rather than being closed over."""
         fused = fused_ok and self.fused == "on"
         limit = jnp.asarray(s.time + self.cfg.max_ticks, _i32)
 
@@ -2408,6 +2622,53 @@ class TickKernel:
             return run(t)
 
         return self._fused_call(step, s, s, self.cfg.max_delay + 1)
+
+    def _fused_stream_drain(self, s: DenseState, in_drain, limit,
+                            chunk: int) -> DenseState:
+        """The streaming engine's per-lane drain slice (parallel/batch
+        lane_pass stage 2), fused: ``chunk`` conditional drain ticks as
+        megatick-sized kernel dispatches plus a plain-scan remainder.
+        Same monotone-cond argument as _drain_and_flush_with — a
+        condition-false step is the identity, so once false it stays
+        false and real ticks form a step prefix. The traced ``in_drain``
+        gate and per-lane ``limit`` ride in the kernel carry rather than
+        being closed over (a Pallas body cannot close over arrays)."""
+        inner = self._fused_inner
+
+        def cond_at(t, dr, lim):
+            c = dr & self._pending(t) & (t.time < lim)
+            if self.quarantine:
+                c = c & (t.error == 0)
+            return c
+
+        def step(carry, ep, ax):
+            t, dr, lim = carry
+            fmk = None if ep is None else self._fmasks_of(ep, ax)
+
+            def run(u):
+                return inner._exact_tick(u, fmk)
+
+            t = lax.cond(cond_at(t, dr, lim), run, lambda u: u, t)
+            return t, dr, lim
+
+        in_drain = jnp.asarray(in_drain, jnp.bool_)
+        limit = jnp.asarray(limit, _i32)
+        K = self.megatick
+        nmega, rem = divmod(int(chunk), K)
+        if nmega:
+            def mega(t, _):
+                t2, _, _ = self._fused_call(
+                    step, (t, in_drain, limit), t, K)
+                return t2, None
+
+            s, _ = lax.scan(mega, s, None, length=nmega)
+        if rem:
+            def one(t, _):
+                return lax.cond(cond_at(t, in_drain, limit),
+                                self._exact_tick, lambda u: u, t), None
+
+            s, _ = lax.scan(one, s, None, length=rem)
+        return s
 
     def _drain_and_flush(self, s: DenseState) -> DenseState:
         return self._drain_and_flush_with(s, self._exact_tick,

@@ -359,7 +359,8 @@ class BatchedRunner:
                  memo: str = "off", memo_cache: Optional[str] = None,
                  memo_cache_entries: int = 0, memo_cache_bytes: int = 0,
                  guards=None, fused_tick: Optional[str] = None,
-                 fused_block_edges: int = 0):
+                 fused_block_edges: int = 0,
+                 fused_tile: Optional[str] = None):
         """scheduler: 'exact' = the reference's delivery semantics
         (bit-exact; the default 'cascade' formulation is O(E) vector work
         + one sequential step per marker delivered — ops/tick._cascade_tick
@@ -418,6 +419,20 @@ class BatchedRunner:
         ("on"/"off") and ``self.fused_reason`` the why; bench
         --fused-tick stamps the row. ``fused_block_edges`` overrides the
         fault-plane DMA block width (0 = default).
+
+        fused_tile: the tiled-state extension of the fused megatick
+        ("auto"/"on"/"off", kernels/megatick.resolve_fused_tile) — with
+        it the [E, C] ring planes stream HBM->VMEM per step instead of
+        living in the carry, so fused execution survives DenseStates
+        past the 12 MB VMEM budget. None defers to the config's knob;
+        "auto" engages exactly when the resident layout would not fit.
+        The streaming engine's drain slice and flush pass additionally
+        route through the fused kernel when the resolution is "on"
+        (TickKernel._fused_stream_drain/_fused_flush) — the stream/serve
+        steady-state step is then one kernel dispatch per stage, the
+        ISSUE-16 "fuse the production path" move. ``self.fused_tile`` /
+        ``self.fused_tile_reason`` expose the resolution; bench
+        --fused-tile stamps the row.
 
         queue_engine: ring-queue addressing (ops/tick.TickKernel): "gather"
         = O(E) head gathers + append scatters over the packed planes,
@@ -520,11 +535,14 @@ class BatchedRunner:
             exact_impl=exact_impl, megatick=megatick,
             queue_engine=queue_engine, kernel_engine=kernel_engine,
             faults=faults, quarantine=quarantine, trace=trace,
-            fused_tick=fused_tick, fused_block_edges=fused_block_edges)
+            fused_tick=fused_tick, fused_block_edges=fused_block_edges,
+            fused_tile=fused_tile)
         self.queue_engine = self.kernel.queue_engine
         self.kernel_engine = self.kernel.kernel_engine
         self.fused = self.kernel.fused
         self.fused_reason = self.kernel.fused_reason
+        self.fused_tile = self.kernel.fused_tile
+        self.fused_tile_reason = self.kernel.fused_tile_reason
         self.faults = faults
         self.quarantine = bool(quarantine)
         self._trace_on = self.kernel._trace_on
@@ -1067,6 +1085,7 @@ class BatchedRunner:
             "queue_engine": self.queue_engine,
             "kernel_engine": self.kernel_engine,
             "fused_tick": self.fused,
+            "fused_tile": self.fused_tile,
             "exact_impl": self.kernel.exact_impl,
             "megatick": self.megatick,
             "check_every": self.check_every,
@@ -1207,10 +1226,22 @@ class BatchedRunner:
                 p = in_drain & kern._pending(t) & (t.time < limit)
                 return (p & (t.error == 0)) if quarantine else p
 
-            def one(t, _):
-                return lax.cond(more(t), self._tick_fn, lambda u: u, t), None
+            # fused stream/serve steady state: with the one-kernel
+            # megatick resolved "on" (exact path only — kern.fused
+            # already encodes that), the drain slice and the flush pass
+            # below each run as fused kernel dispatches instead of
+            # drain_chunk/max_delay+1 scanned cond-ticks — bit-identical
+            # (TickKernel._fused_stream_drain docstring)
+            use_fused = self.scheduler == "exact" and kern.fused == "on"
+            if use_fused:
+                s = kern._fused_stream_drain(s, in_drain, limit,
+                                             drain_chunk)
+            else:
+                def one(t, _):
+                    return lax.cond(more(t), self._tick_fn,
+                                    lambda u: u, t), None
 
-            s, _ = lax.scan(one, s, None, length=drain_chunk)
+                s, _ = lax.scan(one, s, None, length=drain_chunk)
             done = in_drain & ~more(s)
             blown = kern._pending(s)
             if quarantine:
@@ -1222,13 +1253,16 @@ class BatchedRunner:
                                       s.prog_cursor))
 
             def flush(s):
-                tick = self._tick_fn
-                if quarantine:
-                    def tick(t):
-                        return lax.cond(t.error == 0, self._tick_fn,
-                                        lambda u: u, t)
-                s = lax.fori_loop(0, cfg.max_delay + 1,
-                                  lambda _, t: tick(t), s)
+                if use_fused:
+                    s = kern._fused_flush(s)
+                else:
+                    tick = self._tick_fn
+                    if quarantine:
+                        def tick(t):
+                            return lax.cond(t.error == 0, self._tick_fn,
+                                            lambda u: u, t)
+                    s = lax.fori_loop(0, cfg.max_delay + 1,
+                                      lambda _, t: tick(t), s)
                 return s._replace(prog_cursor=s.prog_cursor + 1)
 
             s = lax.cond(stage_of(s) == 3, flush, lambda u: u, s)

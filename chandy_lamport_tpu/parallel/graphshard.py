@@ -279,7 +279,8 @@ class GraphShardedRunner:
                  kernel_engine: Optional[str] = None, megatick: int = 1,
                  quarantine: bool = False, trace=None, guards=None,
                  fused_tick: Optional[str] = None,
-                 fused_block_edges: int = 0):
+                 fused_block_edges: int = 0,
+                 fused_tile: Optional[str] = None):
         """fixed_delay: constant delay instead of the per-shard uniform
         stream — lets differential tests demand bit-equality with the
         unsharded kernel (counter-based streams differ by construction).
@@ -343,7 +344,9 @@ class GraphShardedRunner:
         cannot contain collectives over the graph mesh. "auto" and "off"
         both resolve "off" here; "on" raises, naming the constraint.
         ``fused_block_edges`` is accepted and ignored for the same
-        reason."""
+        reason; ``fused_tile`` (the tiled-state layout of the fused
+        kernel, kernels/megatick.resolve_fused_tile) resolves "off" for
+        the same reason — there is no fused kernel here to tile."""
         self.topo = DenseTopology(topology)
         self.config = config or SimConfig()
         self.guards = guards
@@ -385,6 +388,10 @@ class GraphShardedRunner:
         self.fused = "off"
         self.fused_reason = ("sharded tick crosses shard boundaries "
                              "inside the tick body")
+        from chandy_lamport_tpu.kernels.megatick import resolve_fused_tile
+        self.fused_tile, self.fused_tile_reason = resolve_fused_tile(
+            self.config.fused_tile if fused_tile is None else fused_tile,
+            fused=self.fused, vmem_bytes=0, tiled_vmem_bytes=0)
         if megatick < 1:
             raise ValueError("megatick must be >= 1")
         self.megatick = int(megatick)

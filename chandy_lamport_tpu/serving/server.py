@@ -324,7 +324,13 @@ def serve_run(runner, requests: List[ServeRequest], *,
                     return state, stream, {
                         "serve_schema": SERVE_SCHEMA_VERSION,
                         "killed": True, "steps": steps_now,
-                        "saves": saves, **warm}
+                        "saves": saves,
+                        "fused_tick": runner.fused,
+                        "fused_tile": runner.fused_tile,
+                        "fused_emulated": bool(
+                            runner.fused == "on"
+                            and runner.kernel._pl_interpret),
+                        **warm}
     wall_s = time.perf_counter() - t_loop
 
     # tail arrivals past the last harvest never need the device: the
@@ -383,6 +389,13 @@ def serve_run(runner, requests: List[ServeRequest], *,
         "wall_s": round(wall_s, 3), **_percentiles(admit_all),
         "warmup_s": warm["warmup_s"], "warmup_source": warm["source"],
         "warmup_persisted": warm["persisted"],
+        # serve honesty: which kernel served the run, and whether the
+        # fused dispatches ran interpret-mode Pallas (CPU gauge, not a
+        # TPU win — the tunnel is dead, TPU-blind since r03)
+        "fused_tick": runner.fused,
+        "fused_tile": runner.fused_tile,
+        "fused_emulated": bool(runner.fused == "on"
+                               and runner.kernel._pl_interpret),
     }
     if telemetry is not None:
         telemetry.write("serve_run", dict(report))

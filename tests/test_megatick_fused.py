@@ -11,9 +11,10 @@ Three claims, all on the CPU mesh via interpret-mode Pallas:
    position.
 
 2. ``resolve_fused_tick`` gates honestly: "auto" engages exactly when
-   the documented requirements hold, "on" raises naming the first
-   unmet requirement, and the supervisor/trace arms fall back with a
-   stated reason instead of silently changing semantics.
+   the documented requirements hold (including the supervisor and
+   flight-recorder arms, whose historical refusals ISSUE-16 lifted),
+   and "on" raises naming ALL unmet requirements at once instead of
+   making users discover them one error at a time.
 
 3. The double-buffered HBM->VMEM mask pipeline survives every edge-
    geometry corner: E not divisible by the block width, single-edge
@@ -106,12 +107,25 @@ def test_resolve_fused_tick_auto_gate():
             ("megatick", 1, "megatick"),
             ("marker_mode", "split", "marker"),
             ("exact_impl", "fold", "exact_impl"),
-            ("supervised", True, "supervisor"),
-            ("traced", True, "trace"),
             ("vmem_bytes", plk.FUSED_VMEM_BUDGET + 1, "VMEM")):
         off, why = plk.resolve_fused_tick("auto", **{**base, knob: bad})
         assert off == "off", knob
         assert word.lower() in why.lower(), (knob, why)
+    # the supervisor and flight-recorder arms ENGAGE — the historical
+    # refusals are lifted (both trace as masked lane ops in-kernel)
+    for knob in ("supervised", "traced"):
+        on, why = plk.resolve_fused_tick("auto", **{**base, knob: True})
+        assert on == "on", (knob, why)
+    # an over-budget resident set engages anyway when the TILED working
+    # set fits (the ring planes stream); refuses only when tiled is
+    # over too, or tiling is forbidden (tiled_vmem_bytes=None)
+    big = dict(base, vmem_bytes=plk.FUSED_VMEM_BUDGET + 1)
+    on, why = plk.resolve_fused_tick(
+        "auto", **big, tiled_vmem_bytes=plk.FUSED_VMEM_BUDGET - 1)
+    assert on == "on", why
+    off, why = plk.resolve_fused_tick(
+        "auto", **big, tiled_vmem_bytes=plk.FUSED_VMEM_BUDGET + 1)
+    assert off == "off" and "tiled" in why
     assert plk.resolve_fused_tick("off", **base) == ("off", "fused_tick='off'")
 
 
@@ -123,6 +137,15 @@ def test_resolve_fused_tick_on_raises_naming_requirement():
         plk.resolve_fused_tick("on", **{**base, "kernel_engine": "xla"})
     with pytest.raises(ValueError, match="megatick"):
         plk.resolve_fused_tick("on", **{**base, "megatick": 1})
+    # ALL unmet requirements in one error, counted and named
+    with pytest.raises(ValueError) as ei:
+        plk.resolve_fused_tick("on", **{**base, "kernel_engine": "xla",
+                                        "megatick": 1,
+                                        "marker_mode": "split"})
+    msg = str(ei.value)
+    assert "3 unmet requirement(s)" in msg
+    for word in ("kernel_engine", "megatick", "marker_mode"):
+        assert word in msg, msg
     with pytest.raises(ValueError, match="unknown fused_tick"):
         plk.resolve_fused_tick("sideways", **base)
 
@@ -301,14 +324,17 @@ def test_fused_megatick_past_quiescence(fused_pair10):
 # composition + plumbing
 
 
-def test_fused_auto_falls_back_for_supervisor_and_trace():
+def test_fused_auto_engages_for_supervisor_and_trace():
+    """The production arms ISSUE-16 un-refused: an armed snapshot
+    supervisor and an armed flight recorder no longer knock 'auto' back
+    to the split path — both fuse (their ticks trace in-kernel)."""
     topo = DenseTopology(ring_topology(4, tokens=4))
     delay = FixedJaxDelay(2)
     sup_cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=8,
                         snapshot_timeout=8)
     kern = TickKernel(topo, sup_cfg, delay, megatick=4,
                       kernel_engine="pallas", fused_tick="auto")
-    assert kern.fused == "off" and "supervisor" in kern.fused_reason
+    assert kern.fused == "on", kern.fused_reason
 
     from chandy_lamport_tpu.utils.tracing import JaxTrace
     tr_cfg = SimConfig(max_snapshots=2, queue_capacity=8, max_recorded=8,
@@ -316,7 +342,7 @@ def test_fused_auto_falls_back_for_supervisor_and_trace():
     kern = TickKernel(topo, tr_cfg, delay, megatick=4,
                       kernel_engine="pallas", fused_tick="auto",
                       trace=JaxTrace(capacity=16))
-    assert kern.fused == "off" and "trace" in kern.fused_reason
+    assert kern.fused == "on", kern.fused_reason
 
 
 def test_fused_knob_surfaces_on_runners():

@@ -1,0 +1,359 @@
+"""The TILED megatick (ISSUE-16, kernels/megatick.py module docstring):
+state-plane double-buffering past the VMEM ceiling, and the un-refused
+production arms (supervisor, flight recorder, serve) riding it.
+
+Three claims:
+
+1. Geometry and resolution are exact at the byte: ``fused_vmem_bytes``'s
+   tiled working set matches its documented line items, the
+   ``ring_append_slots`` census matches the per-arm append bound, and
+   the ``resolve_fused_tick``/``resolve_fused_tile`` pair flips at
+   EXACTLY the budget boundary — at-budget stays resident, one byte
+   over streams the rings, 10x over (tiled set over too) refuses.
+
+2. The tiled layout is bit-identical to the resident fused kernel AND
+   the split path on every plane — including the arms whose refusals
+   this issue lifted (armed supervisor, snapshot daemon, flight
+   recorder, and the serve step) and the DMA-schedule corners (single
+   ring block, markers landing on a ring-block seam, fault dup
+   re-appends).
+
+3. A shape whose resident working set exceeds the 12 MB budget — which
+   previously resolved fused_tick='auto' to "off" — now engages with
+   ``fused_tile="on"`` and stays bit-identical to the split path.
+
+Tier-1 keeps the pure geometry tests plus two differential sentinels
+(one tiled arm with seam-crossing markers, one supervised tiled arm);
+the full arm sweep, the over-budget shape, and the serving/stream
+differentials are slow-marked.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.state import DenseTopology, init_state
+from chandy_lamport_tpu.kernels import megatick as plk
+from chandy_lamport_tpu.models.faults import JaxFaults
+from chandy_lamport_tpu.models.workloads import ring_topology
+from chandy_lamport_tpu.ops.delay_jax import HashJaxDelay
+from chandy_lamport_tpu.ops.tick import TickKernel
+from chandy_lamport_tpu.utils.compare import dense_state_mismatches
+from chandy_lamport_tpu.utils.randgen import random_strongly_connected
+
+
+def _assert_identical(a, b):
+    assert dense_state_mismatches(jax.device_get(a), jax.device_get(b)) == []
+
+
+def _diff_arm(cfg, impl="cascade", tile="on", block_edges=5, faults=None,
+              trace=None, ticks=9, n=10, megatick=4, drain=True):
+    """split vs fused(tile) on the strongly-connected 10-node graph:
+    run_ticks (and, unless ``drain=False``, drain_and_flush), every
+    state plane."""
+    topo = DenseTopology(random_strongly_connected(random.Random(11), n))
+    delay = HashJaxDelay(seed=7)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl=impl,
+                          megatick=megatick, queue_engine="auto",
+                          kernel_engine="pallas", faults=faults,
+                          quarantine=faults is not None, trace=trace,
+                          fused_tick=fused, fused_block_edges=block_edges,
+                          fused_tile=tile)
+
+    split, fused = mk("off"), mk("on")
+    assert fused.fused == "on", fused.fused_reason
+    assert fused.fused_tile == tile
+    s = init_state(topo, cfg, delay.init_state(),
+                   fault_key=int(faults.init_state()) if faults else 0)
+    for e in range(0, topo.e, 3):
+        s = split.inject_send(s, np.int32(e), np.int32(2))
+    s = split.inject_snapshot(s, np.int32(0))
+    s = jax.device_get(s)
+    _assert_identical(fused.run_ticks(s, np.int32(ticks)),
+                      split.run_ticks(s, np.int32(ticks)))
+    if drain:
+        _assert_identical(fused.drain_and_flush(s),
+                          split.drain_and_flush(s))
+
+
+_BASE = dict(max_snapshots=4, queue_capacity=32, max_recorded=64)
+
+
+# ---------------------------------------------------------------------------
+# geometry + resolution (pure functions, no compile)
+
+
+def test_ring_append_slots_census():
+    # marker waves bounded by min(S, in_degree), floor 1
+    assert plk.ring_append_slots(max_snapshots=4, max_in_degree=2,
+                                 timeout_armed=False, every_armed=False,
+                                 faulted=False) == 2
+    assert plk.ring_append_slots(max_snapshots=1, max_in_degree=8,
+                                 timeout_armed=False, every_armed=False,
+                                 faulted=False) == 1
+    # supervisor retries add S, the daemon 1, the fault dup 1
+    assert plk.ring_append_slots(max_snapshots=4, max_in_degree=2,
+                                 timeout_armed=True, every_armed=True,
+                                 faulted=True) == 2 + 4 + 1 + 1
+    assert plk.ring_append_slots(max_snapshots=0, max_in_degree=0,
+                                 timeout_armed=False, every_armed=False,
+                                 faulted=False) == 1          # floor
+
+
+def test_tiled_vmem_budget_math():
+    # the documented tiled line items: rings leave the carry, replaced
+    # by the 2-slot x 2-plane [EB, C] DMA scratch, the [A, E] x 3
+    # deferred-append planes, and the two [E] head vectors
+    e, c, a, be = 21, 32, 3, 5
+    base = plk.fused_vmem_bytes(10_000, e=e, n=10, length=4, faulted=False)
+    tiled = plk.fused_vmem_bytes(10_000, e=e, n=10, length=4,
+                                 faulted=False, block_edges=be,
+                                 tiled=True, queue_capacity=c,
+                                 append_slots=a)
+    nb, eb = plk.plan_edge_blocks(e, be)
+    assert tiled == (base - 2 * e * c * 4 + 2 * 2 * eb * c * 4
+                     + 3 * a * e * 4 + 2 * e * 4)
+    with pytest.raises(ValueError, match="queue_capacity"):
+        plk.fused_vmem_bytes(10_000, e=e, n=10, length=4, faulted=False,
+                             tiled=True)
+
+
+def test_resolve_tile_at_budget_boundaries():
+    budget = plk.FUSED_VMEM_BUDGET
+    base = dict(kernel_engine="pallas", megatick=4, marker_mode="ring",
+                exact_impl="cascade", supervised=False, traced=False)
+    # exactly AT the budget: fused engages, tiling would add ring DMA
+    # for nothing — auto stays resident
+    on, _ = plk.resolve_fused_tick("auto", **base, vmem_bytes=budget,
+                                   tiled_vmem_bytes=budget // 2)
+    tile, why = plk.resolve_fused_tile("auto", fused=on, vmem_bytes=budget,
+                                       tiled_vmem_bytes=budget // 2)
+    assert (on, tile) == ("on", "off") and "fits" in why
+    # ONE BYTE over: the rings stream
+    on, _ = plk.resolve_fused_tick("auto", **base, vmem_bytes=budget + 1,
+                                   tiled_vmem_bytes=budget // 2)
+    tile, why = plk.resolve_fused_tile("auto", fused=on,
+                                       vmem_bytes=budget + 1,
+                                       tiled_vmem_bytes=budget // 2)
+    assert (on, tile) == ("on", "on") and "stream" in why
+    # 10x over, tiled set over too: honest refusal naming both figures
+    off, why = plk.resolve_fused_tick("auto", **base,
+                                      vmem_bytes=budget * 10,
+                                      tiled_vmem_bytes=budget * 9)
+    assert off == "off" and "tiled" in why
+    with pytest.raises(ValueError, match="tiled"):
+        plk.resolve_fused_tick("on", **base, vmem_bytes=budget * 10,
+                               tiled_vmem_bytes=budget * 9)
+    # tiling forbidden (fused_tile='off' upstream -> tiled bytes None)
+    off, why = plk.resolve_fused_tick("auto", **base,
+                                      vmem_bytes=budget + 1,
+                                      tiled_vmem_bytes=None)
+    assert off == "off" and "fused_tile='off'" in why
+    # no kernel to tile when the fused megatick itself is off
+    tile, why = plk.resolve_fused_tile("auto", fused="off",
+                                       vmem_bytes=0, tiled_vmem_bytes=0)
+    assert tile == "off" and "no kernel" in why
+    with pytest.raises(ValueError, match="unknown fused_tile"):
+        plk.resolve_fused_tile("sideways", fused="on", vmem_bytes=0,
+                               tiled_vmem_bytes=0)
+
+
+def test_pack_ring_plane_geometry():
+    import jax.numpy as jnp
+    plane = jnp.arange(21 * 4, dtype=jnp.int32).reshape(21, 4)
+    nb, eb = plk.plan_edge_blocks(21, 5)
+    packed = plk._pack_ring_plane(plane, nb, eb)
+    assert packed.shape == (nb, eb, 4)
+    flat = np.asarray(packed).reshape(nb * eb, 4)
+    assert np.array_equal(flat[:21], np.asarray(plane))     # edges intact
+    assert (flat[21:] == 0).all()                           # pads zero
+
+
+def test_ring_heads_matches_gather():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    qm = jnp.asarray(rng.randint(0, 1 << 20, (7, 16)), jnp.int32)
+    qd = jnp.asarray(rng.randint(0, 1 << 20, (7, 16)), jnp.int32)
+    qh = jnp.asarray(rng.randint(0, 16, (7,)), jnp.int32)
+    hm, hd = plk.ring_heads(qm, qd, qh)
+    assert hm.dtype == jnp.int32 and hd.dtype == jnp.int32
+    assert np.array_equal(np.asarray(hm),
+                          np.asarray(qm)[np.arange(7), np.asarray(qh)])
+    assert np.array_equal(np.asarray(hd),
+                          np.asarray(qd)[np.arange(7), np.asarray(qh)])
+
+
+# ---------------------------------------------------------------------------
+# differentials: tier-1 sentinels
+
+
+def test_tiled_supervised_seam_sentinel():
+    """THE tier-1 tiled sentinel, one compile pair for two claims:
+    block_edges=5 on the 21-edge graph puts ring-block seams at edges
+    4|5, 9|10, 14|15, 19|20 and the snapshot broadcast appends markers
+    across every seam (deferred-append commit + head pre-extraction +
+    block-boundary DMA hazards), while the armed supervisor's deadline
+    arithmetic and retry re-initiation append INSIDE the kernel through
+    the same deferred buffers (the head-slot patch threads their
+    appends through the lax.cond/while_loop wrappers as carry
+    dataflow). The drain differential and the remaining arm matrix run
+    in the slow sweep."""
+    _diff_arm(SimConfig(snapshot_timeout=5, snapshot_retries=2, **_BASE),
+              impl="cascade", tile="on", block_edges=5, drain=False)
+
+
+# ---------------------------------------------------------------------------
+# differentials: the full arm sweep (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["cascade", "wave"])
+@pytest.mark.parametrize("arm", ["base", "supervised", "daemon", "traced"])
+def test_tiled_matches_split_full_sweep(arm, impl):
+    cfg = {"base": SimConfig(**_BASE),
+           "supervised": SimConfig(snapshot_timeout=5, snapshot_retries=2,
+                                   **_BASE),
+           "daemon": SimConfig(snapshot_every=6, **_BASE),
+           "traced": SimConfig(trace_capacity=64, **_BASE)}[arm]
+    _diff_arm(cfg, impl=impl, tile="on", trace=(arm == "traced") or None)
+
+
+@pytest.mark.slow
+def test_tiled_single_block_degenerate():
+    # block_edges >= E: RNB=1, the DMA schedule's prologue/epilogue
+    # collapse onto the same block
+    _diff_arm(SimConfig(**_BASE), tile="on", block_edges=64)
+
+
+@pytest.mark.slow
+def test_tiled_matches_split_with_faults():
+    _diff_arm(SimConfig(**_BASE), tile="on",
+              faults=JaxFaults(seed=3, drop_rate=0.25, dup_rate=0.25))
+
+
+@pytest.mark.slow
+def test_tiled_matches_split_faults_and_supervisor_wave():
+    _diff_arm(SimConfig(snapshot_timeout=5, snapshot_retries=2, **_BASE),
+              impl="wave", tile="on",
+              faults=JaxFaults(seed=3, drop_rate=0.25, dup_rate=0.25))
+
+
+@pytest.mark.slow
+def test_tiled_auto_engages_past_vmem_budget():
+    """The acceptance shape: a ring set 2*E*C*4 = 16.8 MB over the 12 MB
+    budget. fused_tick='auto' used to refuse it outright; now auto
+    resolves (fused=on, tile=on) and stays bit-identical to the split
+    path."""
+    spec = ring_topology(256, tokens=512)
+    topo = DenseTopology(spec)
+    cfg = SimConfig(max_snapshots=2, queue_capacity=8192, max_recorded=16)
+    delay = HashJaxDelay(seed=7)
+
+    def mk(fused):
+        return TickKernel(topo, cfg, delay, exact_impl="cascade",
+                          megatick=2, queue_engine="auto",
+                          kernel_engine="pallas", fused_tick=fused,
+                          fused_block_edges=64)
+
+    split, fused = mk("off"), mk("auto")
+    assert fused.fused == "on", fused.fused_reason
+    assert fused.fused_tile == "on", fused.fused_tile_reason
+    s = init_state(topo, cfg, delay.init_state())
+    for e in range(0, topo.e, 31):
+        s = split.inject_send(s, np.int32(e), np.int32(2))
+    s = split.inject_snapshot(s, np.int32(0))
+    s = jax.device_get(s)
+    _assert_identical(fused.run_ticks(s, np.int32(4)),
+                      split.run_ticks(s, np.int32(4)))
+
+
+# ---------------------------------------------------------------------------
+# the fused serve + stream arms
+
+
+def test_serve_report_stamps_fused_fields():
+    """Cheap tier-1 plumbing check: every serve report carries the
+    fused_tick/fused_tile/fused_emulated stamps (bench satellites read
+    them into the JSON rows)."""
+    from chandy_lamport_tpu.models.workloads import serve_workload
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.serving.server import serve_run
+    spec = ring_topology(6, tokens=12)
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=16)
+    runner = BatchedRunner(spec, cfg, HashJaxDelay(seed=7), 2,
+                           scheduler="sync")
+    reqs = serve_workload(spec, 4, seed=17, rate=2.0, tenants=2,
+                          max_phases=4)
+    _, _, report = serve_run(runner, reqs, policy="edf", stretch=2,
+                             drain_chunk=8)
+    assert report["fused_tick"] == "off"
+    assert report["fused_tile"] == "off"
+    assert report["fused_emulated"] is False
+
+
+@pytest.mark.slow
+def test_serve_fused_tiled_matches_split():
+    """The fused serve step (acceptance): one seeded serve schedule
+    driven through fused-resident and fused-tiled kernels must produce
+    byte-identical results to the split path, and the report must stamp
+    fused_emulated=True off-TPU."""
+    from chandy_lamport_tpu.models.workloads import serve_workload
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.serving.server import serve_run
+    spec = ring_topology(8, tokens=16)
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=32)
+    reqs = serve_workload(spec, 6, seed=17, rate=2.0, tenants=2,
+                          max_phases=6)
+
+    def drive(fused, tile):
+        runner = BatchedRunner(spec, cfg, HashJaxDelay(seed=7), 2,
+                               scheduler="exact", megatick=2,
+                               kernel_engine="pallas", fused_tick=fused,
+                               fused_tile=tile)
+        _, stream, report = serve_run(runner, reqs, policy="edf",
+                                      stretch=2, drain_chunk=8)
+        return runner.stream_results(stream), report
+
+    ref, _ = drive("off", "off")
+    for tile in ("off", "on"):
+        rows, report = drive("on", tile)
+        assert report["fused_tick"] == "on"
+        assert report["fused_tile"] == tile
+        assert report["fused_emulated"] is True
+        assert rows == ref, f"tile={tile}"
+
+
+@pytest.mark.slow
+def test_stream_fused_tiled_matches_split():
+    """The stream engine's chunked drain through the fused kernel
+    (_fused_stream_drain), resident and tiled, against the split
+    scanned-cond-tick drain: identical stream state."""
+    import jax.numpy as jnp
+    from chandy_lamport_tpu.models.workloads import stream_jobs
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    spec = ring_topology(8, tokens=16)
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=32)
+    jobs = stream_jobs(spec, 6, seed=5, base_phases=2, max_phases=4)
+
+    def drive(fused, tile):
+        runner = BatchedRunner(spec, cfg, HashJaxDelay(seed=7), 2,
+                               scheduler="exact", megatick=2,
+                               kernel_engine="pallas", fused_tick=fused,
+                               fused_tile=tile)
+        pool = runner.pack_jobs(jobs)
+        _, stream = runner.run_stream(pool, stretch=2, drain_chunk=8)
+        return jax.device_get(stream)
+
+    ref = drive("off", "off")
+    for tile in ("off", "on"):
+        got = drive("on", tile)
+        for f in ref._fields:
+            va, vg = getattr(ref, f), getattr(got, f)
+            if isinstance(va, (np.ndarray, jnp.ndarray)):
+                assert np.array_equal(np.asarray(va), np.asarray(vg)), \
+                    (tile, f)

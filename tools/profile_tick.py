@@ -332,7 +332,11 @@ def main() -> None:
                 out = jfn(st)
             jax.block_until_ready(out)
             mktimings[(k_ticks, arm)] = (time.perf_counter() - t0) / reps
-    print(f"megakernel (run_ticks(K) per dispatch, impl={mk_impl}{note}):",
+    # honesty stamp (bench rows carry the same field): off-TPU the fused
+    # column is interpret-mode Pallas — a CPU gauge, not a TPU fused win
+    mk_emulated = dev.platform != "tpu"
+    print(f"megakernel (run_ticks(K) per dispatch, impl={mk_impl}, "
+          f"fused_emulated={str(mk_emulated).lower()}{note}):",
           file=sys.stderr)
     print(f"  {'K':<4} {'xla ms':>10} {'split ms':>10} {'fused ms':>10} "
           f"{'fused vs split':>14}", file=sys.stderr)

@@ -177,22 +177,31 @@ def _tick_entry(impl, qe, ke, faults, trace) -> Entry:
 
 
 def _fused_kernel(*, exact_impl="cascade", queue_engine="gather",
-                  fused="on", faults=False, n=8):
+                  fused="on", tile="off", faults=False, supervised=False,
+                  traced=False, n=8):
     """A TickKernel on the one-kernel-megatick arm (kernels/megatick.py):
     kernel_engine=pallas + megatick=4 + fused_tick='on' runs the whole
     K-tick loop as ONE interpret-mode Pallas kernel; the 'off' twin is
     the split-kernel baseline the cost plane compares against. K=4 so
     the hbm_model_bytes ratio (fused reads the carry once, split once
     per tick) clears the <=50% gate on the faulted arms too, where the
-    streamed plane bytes are common to both sides."""
+    streamed plane bytes are common to both sides. ``tile='on'`` forces
+    the tiled-state layout (rings stream HBM<->VMEM once per step —
+    its own documented gate, see tools/analyze --cost); ``supervised``
+    arms the snapshot supervisor and ``traced`` the flight recorder —
+    the production arms ISSUE-16 un-refused."""
     from chandy_lamport_tpu.ops.tick import TickKernel
-    cfg = _cfg()
+    cfg = _cfg(**({"snapshot_timeout": 5, "snapshot_retries": 2}
+                  if supervised else {}),
+               **({"trace_capacity": 64} if traced else {}))
     topo = _tick_topo(n)
     delay = _delay()
     kern = TickKernel(
         topo, cfg, delay, exact_impl=exact_impl, megatick=4,
         queue_engine=queue_engine, kernel_engine="pallas",
-        faults=_faults() if faults else None, fused_tick=fused)
+        faults=_faults() if faults else None,
+        trace=_trace() if traced else None,
+        fused_tick=fused, fused_tile=tile)
     from chandy_lamport_tpu.core.state import init_state
     state = init_state(topo, cfg, delay.init_state(),
                        fault_key=3 if faults else 0)
@@ -202,20 +211,34 @@ def _fused_kernel(*, exact_impl="cascade", queue_engine="gather",
 def _fused_extra(kern, state, faults: bool, length: int) -> Dict[str, float]:
     """The analytic HBM round-trip metrics for one fused/split arm
     (megatick.hbm_round_trip_model): the cost plane pins both so the
-    fused arm's ceiling provably sits at <= 50% of the split arm's."""
+    fused arm's ceiling provably sits at <= 50% of the split arm's —
+    and the TILED fused arm's at <= the tiled gate (the rings leave the
+    resident set but re-cross HBM once per step; tools/analyze --cost
+    prints the cross-check rows)."""
     from chandy_lamport_tpu.kernels import megatick as mt
     state_bytes = mt.pytree_bytes(state)
     plane_bytes = (length * (8 * kern.topo.e + 2 * kern.topo.n) * 4
                    if faults else 0)
+    tiled = getattr(kern, "fused_tile", "off") == "on"
+    ring_bytes = 2 * kern.topo.e * kern.cfg.queue_capacity * 4
     return {"hbm_model_bytes": float(mt.hbm_round_trip_model(
-        state_bytes, plane_bytes, length, fused=kern.fused == "on"))}
+        state_bytes, plane_bytes, length, fused=kern.fused == "on",
+        ring_bytes=ring_bytes, tiled=tiled))}
 
 
-def _fused_entry(impl, qe, faults, surface, fused="on") -> Entry:
+def _fused_entry(impl, qe, faults, surface, fused="on", tile="off",
+                 supervised=False, traced=False) -> Entry:
     import jax.numpy as jnp
     kern, state = _fused_kernel(exact_impl=impl, queue_engine=qe,
-                                fused=fused, faults=faults)
+                                fused=fused, tile=tile, faults=faults,
+                                supervised=supervised, traced=traced)
     tag = "fused" if fused == "on" else "megasplit"
+    if tile == "on":
+        tag += ".tiled"
+    if supervised:
+        tag += ".sup"
+    if traced:
+        tag += ".tr"
     key = f"tick.{tag}.{impl}.q={qe}.f={int(faults)}.{surface}"
     extra = _fused_extra(kern, state, faults, kern.megatick)
     if surface == "run_ticks":
@@ -402,7 +425,9 @@ def iter_entry_builders(mode: str = "full"):
     deadline/tenant harvest books), both graphshard comm engines, the
     Pallas kernels under interpret, and the one-kernel-megatick arms
     (fused impl x queue x faults on run_ticks, fused drain, and the
-    split-kernel twins that anchor the hbm_model_bytes comparison).
+    split-kernel twins that anchor the hbm_model_bytes comparison —
+    plus the ISSUE-16 tiled-state arms and the un-refused supervised/
+    traced production arms with their own megasplit anchors).
 
     fast — one arm per engine axis on the same tiny graphs: enough for
     tier-1 to prove the audit machinery against live traces without
@@ -462,6 +487,32 @@ def iter_entry_builders(mode: str = "full"):
         yield f"tick.megasplit.cascade.q=gather.f={int(faults)}.run_ticks", (
             lambda f=faults: _fused_entry("cascade", "gather", f,
                                           "run_ticks", fused="off"))
+    # ISSUE-16 arms: the TILED fused layout (rings stream HBM<->VMEM,
+    # megatick.RingStream) on both impls and both adversary settings,
+    # plus the un-refused production arms — supervisor and flight
+    # recorder in-kernel — each with its megasplit twin so the tiled/
+    # supervised hbm_model_bytes ratios have same-config anchors
+    # (tools/analyze --cost prints the cross-check rows)
+    for impl in ("cascade", "wave"):
+        for faults in (False, True):
+            key = f"tick.fused.tiled.{impl}.q=gather.f={int(faults)}.run_ticks"
+            yield key, (lambda i=impl, f=faults:
+                        _fused_entry(i, "gather", f, "run_ticks", tile="on"))
+    yield "tick.fused.tiled.cascade.q=gather.f=0.drain", (
+        lambda: _fused_entry("cascade", "gather", False, "drain", tile="on"))
+    for sup, tr in ((True, False), (False, True), (True, True)):
+        tag = ".".join([t for t, on in (("sup", sup), ("tr", tr)) if on])
+        yield f"tick.fused.{tag}.cascade.q=gather.f=0.run_ticks", (
+            lambda s=sup, t=tr: _fused_entry(
+                "cascade", "gather", False, "run_ticks",
+                supervised=s, traced=t))
+        yield f"tick.megasplit.{tag}.cascade.q=gather.f=0.run_ticks", (
+            lambda s=sup, t=tr: _fused_entry(
+                "cascade", "gather", False, "run_ticks", fused="off",
+                supervised=s, traced=t))
+    yield "tick.fused.tiled.sup.cascade.q=gather.f=0.run_ticks", (
+        lambda: _fused_entry("cascade", "gather", False, "run_ticks",
+                             tile="on", supervised=True))
     for name, key in (("run_ticks", "tick.run_ticks"),
                       ("drain", "tick.drain_and_flush"),
                       ("inject_send", "tick.inject_send"),

@@ -20,9 +20,11 @@ entirely, as an XlaRuntimeError from the transfer guard) and fails
 named.
 
 Rows (full mode): stream {sync,exact} x memo {off,admit,full} + serve
-{edf,fifo} + one graphshard storm arm + one fused-megatick stream arm
-(kernel_engine=pallas, fused_tick=on: the steady-state loop dispatches
-the one-kernel megatick, proving the fused path adds no host sync or
+{edf,fifo} + one graphshard storm arm + three fused-megatick arms
+(kernel_engine=pallas, fused_tick=on: a plain stream arm, a SUPERVISED
+stream arm with the in-kernel deadline supervisor armed, and a fused
+serve arm over the exact scheduler — the steady-state loops dispatch
+the one-kernel megatick, proving the fused paths add no host sync or
 retrace). Fast mode keeps one row per loop family for tier-1.
 """
 
@@ -55,12 +57,13 @@ def _topo():
     return ring_topology(8, tokens=16)
 
 
-def _runner(scheduler: str, memo: str, guards, **knobs):
+def _runner(scheduler: str, memo: str, guards, cfg=None, **knobs):
     from chandy_lamport_tpu.config import SimConfig
     from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
     from chandy_lamport_tpu.parallel.batch import BatchedRunner
     return BatchedRunner(
-        _topo(), SimConfig.for_workload(snapshots=2, max_recorded=32),
+        _topo(),
+        SimConfig.for_workload(snapshots=2, max_recorded=32, **(cfg or {})),
         make_fast_delay("hash", 7), 2, scheduler=scheduler, megatick=2,
         memo=memo, guards=guards, **knobs)
 
@@ -84,13 +87,13 @@ def _check_books(key: str, books: dict, allowed: FrozenSet[str],
     return out
 
 
-def _stream_row(key: str, scheduler: str, memo: str, **knobs) -> Tuple[
-        List[Violation], int]:
+def _stream_row(key: str, scheduler: str, memo: str, cfg=None,
+                **knobs) -> Tuple[List[Violation], int]:
     from chandy_lamport_tpu.models.workloads import stream_jobs
     from chandy_lamport_tpu.utils.guards import RuntimeGuards
 
     guards = RuntimeGuards()
-    runner = _runner(scheduler, memo, guards, **knobs)
+    runner = _runner(scheduler, memo, guards, cfg=cfg, **knobs)
     jobs = stream_jobs(_topo(), 6, seed=5, base_phases=2, max_phases=4,
                        dup_rate=0.5 if memo != "off" else 0.0)
     pool = runner.pack_jobs(jobs,
@@ -103,14 +106,15 @@ def _stream_row(key: str, scheduler: str, memo: str, **knobs) -> Tuple[
     return _check_books(key, guards.books(), STREAM_SITES, steps), steps
 
 
-def _serve_row(key: str, policy: str) -> Tuple[List[Violation], int]:
+def _serve_row(key: str, policy: str, scheduler: str = "sync",
+               **knobs) -> Tuple[List[Violation], int]:
     from chandy_lamport_tpu.models.workloads import serve_workload
     from chandy_lamport_tpu.serving.executables import ExecutableCache
     from chandy_lamport_tpu.serving.server import serve_run
     from chandy_lamport_tpu.utils.guards import RuntimeGuards
 
     guards = RuntimeGuards()
-    runner = _runner("sync", "off", guards)
+    runner = _runner(scheduler, "off", guards, **knobs)
     reqs = serve_workload(_topo(), 6, seed=17, rate=2.0, tenants=2,
                           max_phases=6)
     cache = ExecutableCache(None)  # shared: second run hits memory plane
@@ -185,6 +189,23 @@ def iter_rows(mode: str = "full"):
             ("stream.exact.fused",
              lambda: _stream_row("stream.exact.fused", "exact", "off",
                                  kernel_engine="pallas", fused_tick="on")),
+            # the SUPERVISED fused arm: deadline arithmetic and retry
+            # re-initiation run inside the kernel (ISSUE-16 lifted the
+            # production refusal) — an armed supervisor must add no host
+            # sync or per-step retrace over the unsupervised row
+            ("stream.exact.fused.sup",
+             lambda: _stream_row(
+                 "stream.exact.fused.sup", "exact", "off",
+                 cfg={"snapshot_timeout": 5, "snapshot_retries": 2},
+                 kernel_engine="pallas", fused_tick="on")),
+            # the fused SERVE step: the online server's steady-state loop
+            # dispatches the same fused drain through the exact scheduler
+            # — same serve-site allowlist, so the fused path may not add
+            # admission-loop syncs beyond the declared per-step scalars
+            ("serve.edf.fused",
+             lambda: _serve_row("serve.edf.fused", "edf",
+                                scheduler="exact", kernel_engine="pallas",
+                                fused_tick="on")),
         ]
     return rows
 
