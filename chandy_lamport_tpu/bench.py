@@ -344,6 +344,24 @@ def _parser() -> argparse.ArgumentParser:
                    help="--serve: Zipf-weighted tenant population")
     p.add_argument("--priorities", type=int, default=2,
                    help="--serve: priority classes")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="measure the crash-tolerant serving fleet "
+                        "(serving/fleet.fleet_run): N spawned workers over "
+                        "one WAL admission spool serving --jobs requests "
+                        "at the --rate/--tenants/--priorities schedule, "
+                        "reported as served jobs/s with goodput, the "
+                        "latency percentiles and the WAL conservation "
+                        "audit (lost/double-served must be 0) in the row; "
+                        "requires --graph ring (the picklable worker "
+                        "recipe reconstructs a ring-stream engine)")
+    p.add_argument("--fleet-crashes", type=int, default=0, metavar="K",
+                   help="--fleet: SIGKILL a live worker K times on a "
+                        "fixed schedule mid-run — the degraded-mode SLO "
+                        "row; leases expire, in-flight requests are "
+                        "redelivered, and the audit must still balance")
+    p.add_argument("--fleet-lease-ttl", type=float, default=4.0,
+                   help="--fleet: lease expiry (s) before a silent "
+                        "worker's in-flight requests are redelivered")
     p.add_argument("--trace", action="store_true",
                    help="arm the device flight recorder (utils/tracing.py) "
                         "during the measurement; the row gains trace_"
@@ -553,6 +571,8 @@ def run_worker(args) -> int:
 
     if args.graphshard:
         return run_graphshard_worker(args, dev, spec, cfg)
+    if args.fleet:
+        return run_fleet_worker(args, dev, spec, cfg)
     if args.serve:
         return run_serve_worker(args, dev, spec, cfg)
     if args.stream:
@@ -1181,6 +1201,110 @@ def run_serve_worker(args, dev, spec, cfg) -> int:
             + "serving throughput is platform-relative, not a chip "
               "throughput claim")
     _write_telemetry(args, "bench_serve", result)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def run_fleet_worker(args, dev, spec, cfg) -> int:
+    """--fleet N: the HA serving-fleet metric (serving/fleet.fleet_run).
+    One seeded Poisson/Zipf schedule admitted into a fresh WAL spool and
+    served by N spawned workers; --fleet-crashes K adds the degraded-mode
+    arm (the supervisor SIGKILLs a live worker K times on a fixed
+    schedule — leases expire, in-flight requests are redelivered, the
+    conservation audit must still balance). The row is one SLO-ladder
+    point: served jobs/s, goodput, request-latency percentiles, the
+    takeover/restart books and the audit verdict; tools/analyze.py
+    --slo-ladder draws the knee curve from a JSONL stream of these."""
+    import tempfile
+    import time as _time
+
+    from chandy_lamport_tpu.models.workloads import (
+        crash_schedule,
+        serve_workload,
+    )
+    from chandy_lamport_tpu.serving.fleet import fleet_run
+
+    if args.graph != "ring":
+        log("--fleet requires --graph ring: the spawn-crossing worker "
+            "recipe reconstructs a ring-stream engine")
+        return 1
+    rcount = args.jobs or 3 * args.batch
+    requests = serve_workload(spec, rcount, seed=17, rate=args.rate,
+                              tenants=args.tenants,
+                              priorities=args.priorities,
+                              dup_rate=args.dup_rate,
+                              max_phases=max(args.phases, 4))
+    log(f"fleet: {rcount} requests, {args.fleet} worker(s), "
+        f"rate {args.rate}/step, crashes={args.fleet_crashes}, "
+        f"lease_ttl={args.fleet_lease_ttl}s")
+    run_dir = tempfile.mkdtemp(prefix="clsim-fleet-")
+    recipe = {"kind": "ring-stream", "n": args.nodes,
+              "tokens": args.phases + 10, "snapshots": args.snapshots,
+              "max_recorded": cfg.max_recorded,
+              "batch": args.batch, "scheduler": args.scheduler,
+              "delay": args.delay,
+              "memo_cache": os.path.join(run_dir, "memo.jsonl")}
+    kills = crash_schedule(args.fleet_crashes, 2.0, start_s=4.0)
+    t0 = _time.perf_counter()
+    rep = fleet_run(requests, spool_path=os.path.join(run_dir, "wal.jsonl"),
+                    workers=args.fleet, recipe=recipe,
+                    lease_ttl=args.fleet_lease_ttl,
+                    crash_schedule=kills, restart_backoff=0.2,
+                    stretch=args.stretch, drain_chunk=args.drain_chunk,
+                    max_wall_s=420.0)
+    wall = _time.perf_counter() - t0
+    if rep["timed_out"]:
+        log("ERROR: fleet run hit max_wall_s before every request was "
+            "terminal — results invalid")
+        return 1
+    if rep["audit"]["lost"] or rep["audit"]["double_served"]:
+        log(f"ERROR: WAL audit failed — lost={rep['audit']['lost']}, "
+            f"double_served={rep['audit']['double_served']}")
+        return 1
+    log(f"fleet: served {rep['served']}/{rcount} in {rep['wall_s']:.1f}s "
+        f"(goodput {rep['goodput']:.2f}), deaths="
+        f"{rep['books']['worker_deaths']} takeovers="
+        f"{rep['books']['takeovers']} restarts={rep['books']['restarts']}")
+    mem = _memory_stats(dev)
+    result = {
+        "metric": "fleet_served_jobs_per_sec",
+        "value": round(rep["served"] / rep["wall_s"], 2)
+        if rep["wall_s"] else 0.0,
+        "unit": "jobs/s",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "scheduler": (args.scheduler if args.scheduler == "sync"
+                      else f"exact/{args.exact_impl}"),
+        "graph": args.graph, "nodes": args.nodes, "batch": args.batch,
+        "requests": rcount, "rate": args.rate, "tenants": args.tenants,
+        "workers": args.fleet, "crashes_injected": args.fleet_crashes,
+        "lease_ttl_s": args.fleet_lease_ttl,
+        "stretch": args.stretch, "drain_chunk": args.drain_chunk,
+        "served": rep["served"], "goodput": rep["goodput"],
+        "shed": len(rep["shed"]), "poisoned": len(rep["poisoned"]),
+        "lat_p50_s": rep["lat_p50_s"], "lat_p99_s": rep["lat_p99_s"],
+        "lat_max_s": rep["lat_max_s"],
+        "worker_deaths": rep["books"]["worker_deaths"],
+        "takeovers": rep["books"]["takeovers"],
+        "restarts": rep["books"]["restarts"],
+        "cache_served": sum(1 for v in rep["results"].values()
+                            if v.get("served_from") == "fleet-cache"),
+        "audit_lost": rep["audit"]["lost"],
+        "audit_double_served": rep["audit"]["double_served"],
+        "wall_total_s": round(wall, 2),
+        "serve_wall_s": rep["wall_s"],
+        "serve_schema": rep["serve_schema"],
+    }
+    result.update(mem)
+    if dev.platform != "tpu":
+        deliberate = (os.environ.get("CLSIM_PLATFORM") == "cpu"
+                      and "CLSIM_FALLBACK" not in os.environ)
+        result["note"] = (
+            ("deliberate CPU run; " if deliberate
+             else "non-TPU fallback (device tunnel down?); ")
+            + "fleet throughput is platform-relative, not a chip "
+              "throughput claim")
+    _write_telemetry(args, "bench_fleet", result)
     print(json.dumps(result), flush=True)
     return 0
 

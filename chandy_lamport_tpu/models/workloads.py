@@ -216,6 +216,52 @@ def serve_workload(spec: TopologySpec, count: int, seed: int,
     return reqs
 
 
+def burst_workload(spec: TopologySpec, count: int, seed: int,
+                   rate: float = 1.0, burst_period: int = 32,
+                   burst_factor: float = 8.0,
+                   **kwargs) -> List[ServeRequest]:
+    """A bursty open-loop serving trace for the fleet's load-shedding
+    and degraded-mode scenarios: the ``serve_workload`` request mix with
+    its Poisson arrivals re-timed by an ON/OFF modulated rate — during
+    the first half of each ``burst_period`` steps arrivals come
+    ``burst_factor``x faster than ``rate``, during the second half
+    ``burst_factor``x slower, so backlog builds in deterministic waves
+    instead of a smooth trickle. Per-request deadline SLACK is preserved
+    (deadlines ride the re-timed arrivals), tenants/priorities/scripts
+    are untouched. Deterministic in ``seed``; extra kwargs forward to
+    ``serve_workload``."""
+    if burst_period < 2:
+        raise ValueError("burst_period must be >= 2 steps")
+    if burst_factor <= 1.0:
+        raise ValueError("burst_factor must be > 1")
+    reqs = serve_workload(spec, count, seed, rate=rate, **kwargs)
+    rng = random.Random(seed + 0xB125)
+    clock = 0.0
+    out: List[ServeRequest] = []
+    for r in reqs:
+        on = (int(clock) % int(burst_period)) < int(burst_period) // 2
+        eff = rate * burst_factor if on else rate / burst_factor
+        clock += rng.expovariate(eff)
+        arrival = int(clock)
+        slack = r.deadline_step - r.arrival_step
+        out.append(r._replace(arrival_step=arrival,
+                              deadline_step=arrival + slack))
+    return out
+
+
+def crash_schedule(kills: int, period_s: float,
+                   start_s: float = 1.0) -> List[float]:
+    """Deterministic worker-kill times (elapsed seconds) for
+    serving/fleet.fleet_run's injected crash schedule — the degraded-
+    mode SLO arm SIGKILLs one live worker at each returned instant:
+    the first at ``start_s``, then every ``period_s``."""
+    if kills < 0:
+        raise ValueError("kills must be >= 0")
+    if period_s <= 0 or start_s < 0:
+        raise ValueError("period_s must be > 0 and start_s >= 0")
+    return [start_s + k * period_s for k in range(kills)]
+
+
 class StormProgram(NamedTuple):
     """Compiled storm traffic: T phases, each = bulk sends + snapshot
     initiations + one tick."""

@@ -14,6 +14,15 @@ Layers (each its own module, host-side unless noted):
   server       ``serve_run``: the double-buffered host loop driving
                BatchedRunner's serving-mode stream step (the device
                half lives in parallel/batch.py behind ``serve=True``).
+  spool        the write-ahead admission spool: fsync-appended journal
+               of admit/lease/done records arbitrating exactly-once
+               serving across worker crashes (``WAL_SCHEMA_VERSION``
+               stamps every record; a stale journal is refused with
+               ``SpoolError``).
+  fleet        clsim-serve-ha: the multi-process worker fleet over the
+               spool — supervisor (lease reclaim, doubling-backoff
+               restart, poison quarantine, deadline-aware shedding)
+               plus the worker serve loop.
 
 ``SERVE_SCHEMA_VERSION`` stamps every serve telemetry record
 (``serve_schema`` key) and checkpoint meta; bump it when the serve
@@ -26,20 +35,40 @@ from chandy_lamport_tpu.serving.admission import (
     order_eligible,
     plan_ingest,
     resolve_serve_policy,
+    shed_order,
 )
 from chandy_lamport_tpu.serving.executables import (
     EXEC_CACHE_SCHEMA_VERSION,
     ExecutableCache,
 )
+from chandy_lamport_tpu.serving.fleet import (
+    fleet_run,
+    recipe_runner,
+    worker_serve,
+)
 from chandy_lamport_tpu.serving.server import SERVE_SCHEMA_VERSION, serve_run
+from chandy_lamport_tpu.serving.spool import (
+    WAL_SCHEMA_VERSION,
+    AdmissionSpool,
+    SpoolError,
+    request_digest,
+)
 
 __all__ = [
     "EXEC_CACHE_SCHEMA_VERSION",
     "ExecutableCache",
     "SERVE_SCHEMA_VERSION",
+    "WAL_SCHEMA_VERSION",
+    "AdmissionSpool",
+    "SpoolError",
     "admission_key",
+    "fleet_run",
     "order_eligible",
     "plan_ingest",
+    "recipe_runner",
+    "request_digest",
     "resolve_serve_policy",
     "serve_run",
+    "shed_order",
+    "worker_serve",
 ]

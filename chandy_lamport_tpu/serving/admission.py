@@ -65,6 +65,20 @@ def order_eligible(eligible: Sequence[ServeRequest],
     return sorted(eligible, key=lambda r: admission_key(r, policy))
 
 
+def shed_order(candidates: Sequence[ServeRequest]) -> List[ServeRequest]:
+    """Deadline-aware load-shedding order: who to drop FIRST when the
+    fleet must shrink its backlog (serving/fleet.py under quota pressure
+    or worker loss). The mirror image of the EDF admission key — the
+    lowest priority class goes first, and within a class the LATEST
+    deadline (the job with the most slack left, i.e. the least urgent
+    investment) is dropped before tighter ones; latest arrival, then
+    highest job id, break ties so the order is total and a resumed
+    supervisor sheds the identical victims."""
+    return sorted(candidates,
+                  key=lambda r: (r.priority, -r.deadline_step,
+                                 -r.arrival_step, -r.job))
+
+
 def plan_ingest(requests: Sequence[ServeRequest], digests: Sequence[str],
                 cache: SummaryCache,
                 quotas: Optional[Sequence[int]] = None) -> dict:

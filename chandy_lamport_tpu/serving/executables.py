@@ -50,6 +50,11 @@ import jax
 import numpy as np
 from jax import export as jax_export
 
+from chandy_lamport_tpu.utils.atomicio import (
+    crash_failpoint,
+    fsync_dir,
+    fsync_file,
+)
 from chandy_lamport_tpu.utils.filelock import locked
 from chandy_lamport_tpu.utils.memocache import _canon
 
@@ -208,7 +213,10 @@ class ExecutableCache:
             with locked(apath):
                 with open(tmp, "wb") as f:
                     f.write(blob)
+                    fsync_file(f)
+                crash_failpoint("execcache-replace")
                 os.replace(tmp, apath)
+                fsync_dir(apath)
             return True, None
         except Exception as exc:
             return False, f"{type(exc).__name__}: {exc}"

@@ -1,0 +1,91 @@
+"""Durable write primitives shared by every on-disk artifact writer.
+
+The atomic-rename discipline (tmp-then-``os.replace``, checkpoint.py)
+protects readers from a *killed writer*: the old complete file survives
+any SIGKILL. It does NOT, by itself, protect against the page cache: an
+``os.replace`` whose tmp bytes were never fsynced can commit a name that
+points at data the kernel has not written back, so a power cut (or a
+container teardown) after the rename leaves the NEW name holding torn
+bytes — exactly the artifact the rename was supposed to make impossible.
+The serve-ha write-ahead spool (serving/spool.py) raises the stakes: its
+append IS the acknowledgement, so an un-fsynced ack is a lost request.
+
+This module is the one home for the missing fsync coverage:
+
+``fsync_append(f, data)``
+    THE named append helper for write-ahead logs (the ``wal-append`` AST
+    rule pins serving/spool.py's writes to it): write + flush +
+    ``os.fsync`` before returning, so the record is on stable storage
+    the moment the caller acks. ``f`` must be opened in binary append
+    mode; returns the byte count so callers can track file offsets.
+
+``fsync_file(f)`` / ``fsync_dir(path)``
+    flush+fsync an open handle; fsync the parent directory so the
+    *rename itself* is durable (POSIX leaves directory entries to their
+    own writeback). Directory fsync is best-effort — some filesystems
+    refuse it — because it only widens the power-cut window, never the
+    kill window.
+
+``crash_failpoint(name)``
+    the kill-in-the-window test hook: SIGKILL this process iff the
+    ``CLSIM_IO_FAILPOINT`` env var names this site. Writers place it in
+    their tmp-write -> replace window (and the spool before its append)
+    so tests can prove a killed writer leaves the previous file loadable
+    and the journal on a record boundary. A no-op in production (env
+    unset).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+# set to a site name ("memocache-replace", "checkpoint-replace",
+# "execcache-replace", "spool-append") to SIGKILL the process at that
+# site — the chaos/recovery tests' deterministic mid-write kill
+FAILPOINT_ENV = "CLSIM_IO_FAILPOINT"
+
+
+def crash_failpoint(name: str) -> None:
+    """Die by SIGKILL iff the failpoint env var names this site."""
+    if os.environ.get(FAILPOINT_ENV) == name:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def fsync_file(f) -> None:
+    """Flush python buffers and fsync the OS file — the caller's bytes
+    are on stable storage when this returns."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of ``path``'s parent directory, making a just-
+    committed rename durable across power loss. Filesystems that refuse
+    directory fsync degrade silently — the kill-safety story does not
+    depend on it."""
+    parent = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(parent, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+    finally:
+        os.close(fd)
+
+
+def fsync_append(f, data: bytes) -> int:
+    """Durably append ``data`` to the open binary handle ``f``: the
+    write, a flush and an ``os.fsync`` complete before return, so a
+    record appended here may be acknowledged to the caller. The one
+    legal torn shape a mid-append kill can leave is a proper PREFIX of
+    ``data`` at EOF (the spool's replay truncates it away). Returns
+    ``len(data)`` for offset bookkeeping."""
+    f.write(data)
+    f.flush()
+    os.fsync(f.fileno())
+    return len(data)

@@ -37,6 +37,11 @@ import jax
 import numpy as np
 
 from chandy_lamport_tpu.core.state import CHECKPOINT_FORMAT_VERSION, DenseState
+from chandy_lamport_tpu.utils.atomicio import (
+    crash_failpoint,
+    fsync_dir,
+    fsync_file,
+)
 
 # The version history table lives beside the state plan it versions:
 # core/state.py CHECKPOINT_FORMAT_HISTORY, one row per breaking layout
@@ -81,7 +86,10 @@ def save_state(path: str, state: DenseState, meta: dict | None = None) -> None:
         # ".npz" to the tmp name, which would break the rename
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
+            fsync_file(f)
+        crash_failpoint("checkpoint-replace")
         os.replace(tmp, path)
+        fsync_dir(path)
     except BaseException:
         try:
             os.unlink(tmp)

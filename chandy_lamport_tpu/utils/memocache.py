@@ -42,6 +42,11 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from chandy_lamport_tpu.config import ENGINE_KNOBS
+from chandy_lamport_tpu.utils.atomicio import (
+    crash_failpoint,
+    fsync_dir,
+    fsync_file,
+)
 from chandy_lamport_tpu.utils.filelock import locked
 
 # THE memocache schema version: one named registry constant, bumped on
@@ -291,7 +296,13 @@ class SummaryCache:
                             {"schema": MEMOCACHE_SCHEMA_VERSION,
                              "digest": digest, "summary": summary},
                             sort_keys=True) + "\n")
+                    # the tmp bytes must be on stable storage BEFORE the
+                    # rename commits the name to them, or a power cut
+                    # after the replace leaves the new name torn
+                    fsync_file(f)
+                crash_failpoint("memocache-replace")
                 os.replace(tmp, self.path)
+                fsync_dir(self.path)
                 self._dirty = False
             except BaseException:
                 try:

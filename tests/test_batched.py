@@ -72,6 +72,7 @@ def test_batched_lanes_match_single_instance_goexact():
                 == single_sim.node_tokens())
 
 
+@pytest.mark.slow  # ~9 s; the goexact leg above keeps batched-vs-single in tier-1
 def test_batched_lanes_match_single_instance_fixed_delay():
     topo_spec, events = _fixture("2nodes.top", "2nodes-message.events")
     single_snaps, _ = run_events("jax", topo_spec, events, FixedDelay(2))

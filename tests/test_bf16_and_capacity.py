@@ -191,6 +191,7 @@ def test_for_workload_sizes_the_bench_config():
         snapshots=2, record_dtype="int16").record_dtype == "int16"
 
 
+@pytest.mark.slow  # ~10 s; the forced-bf16 differential keeps capacity derivation tier-1
 def test_bench_workload_runs_clean_at_derived_capacity():
     """The bench's own storm (scaled to CPU size) fires no overflow at the
     derived capacity — the regression that zeroed BENCH_r02."""

@@ -3,7 +3,10 @@
 One subprocess run of the whole battery — every fault class injected once,
 recovery (or quarantine, for the deliberately-unrecoverable scenario)
 asserted by the tool itself; this test just demands the verdict and pins
-the JSON shape the CI driver consumes.
+the JSON shape the CI driver consumes. The serve-fleet trio
+(``--fleet-only``: worker SIGKILL -> lease takeover with the WAL audit
+and solo bit-identity, poison quarantine, shed under pressure) is cheap
+enough to stay in tier-1 on its own.
 """
 
 import json
@@ -66,3 +69,43 @@ def test_chaos_smoke_battery_green():
     assert tr["trace_events"] > 0 and tr["trace_dropped"] == 0
     assert tr["checks"]["abort_retry_reinit_visible"]
     assert tr["snapshot_lifecycle"]["retried"] > 0
+
+
+# ~25 s on the 1-core box (one jitted engine per fleet worker + the solo
+# identity baseline; the poison/shed scenarios ride the jax-free null
+# executor) — inside the tier-1 per-test budget, unlike the battery
+def test_chaos_smoke_fleet_scenarios_green():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py"),
+         "--fleet-only"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    rows = {r["scenario"]: r for r in verdict["scenarios"]}
+    assert set(rows) == {"fleet-kill-takeover", "fleet-poison-quarantine",
+                         "fleet-shed-pressure"}
+    # A: a worker really died mid-flight, its lease was taken over, and
+    # the WAL audit balanced — zero lost, zero double-served, every
+    # served summary bit-identical to a solo run_stream of that request
+    takeover = rows["fleet-kill-takeover"]
+    assert takeover["books"]["worker_deaths"] >= 1
+    assert takeover["books"]["takeovers"] >= 1
+    assert takeover["audit"]["lost"] == 0
+    assert takeover["audit"]["double_served"] == 0
+    assert takeover["checks"]["bit_identical_to_solo"]
+    assert takeover["checks"]["killed_exactly_once"]
+    # B: the crash-looping request was quarantined as poison with one
+    # decoded provenance entry per burned attempt; the rest still served
+    poison = rows["fleet-poison-quarantine"]
+    assert list(poison["poisoned"]) == ["1"]
+    assert len(poison["poisoned"]["1"]["errors"]) == 2
+    assert all("SIGKILL" in e for e in poison["poisoned"]["1"]["errors"])
+    assert poison["audit"]["lost"] == 0
+    # C: shedding dropped exactly admission.shed_order's predicted
+    # victims, and the terminal states still conserve every admit
+    shed = rows["fleet-shed-pressure"]
+    assert shed["shed"] == shed["predicted"]
+    assert shed["audit"]["lost"] == 0
+    for row in verdict["scenarios"]:
+        assert row["ok"], row

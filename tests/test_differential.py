@@ -25,7 +25,11 @@ from chandy_lamport_tpu.utils.randgen import (
 )
 
 
-@pytest.mark.parametrize("case_seed", range(8))
+@pytest.mark.parametrize("case_seed", [
+    # every seed compiles its own random topology (~4-7 s each on the
+    # 1-core gate box); seed 0 keeps the parity-vs-dense differential in
+    # tier-1, the rest of the battery runs in full passes
+    0, *(pytest.param(s, marks=pytest.mark.slow) for s in range(1, 8))])
 def test_parity_vs_dense_random(case_seed):
     rng = random.Random(1000 + case_seed)
     topo = random_strongly_connected(rng, rng.randrange(3, 12))

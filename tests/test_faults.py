@@ -137,6 +137,7 @@ def test_fault_classes_fire_and_conserve(classes, kw, scheduler):
     assert summary["error_lanes"] == 0, summary["errors_decoded"]
 
 
+@pytest.mark.slow  # ~14 s; quarantine isolation + the chaos fault classes stay tier-1
 def test_fault_program_replays_bit_exactly():
     adversary = JaxFaults(3, drop_rate=0.05, dup_rate=0.05, jitter_rate=0.05)
     runner, a = _storm(adversary)

@@ -1,0 +1,250 @@
+"""clsim-serve-ha (serving/fleet.py): supervisor logic, the worker serve
+loop, and the fleet differential.
+
+Tier-1 keeps to the cheap arms: pure host logic (shed ordering, exit
+provenance, recipes, the burst/crash-schedule workload builders), the
+worker loop driven IN-PROCESS against the shared session runner (one
+compile, no spawn), and one real one-worker null-executor fleet (the
+spawn plumbing, ~2 s). The multi-worker real-engine differential with
+chaos kills rides tools/chaos_smoke.py --fleet-only
+(tests/test_chaos_smoke.py) and the full multiprocess scaling pass here
+is the slow marker.
+"""
+
+import os
+
+import pytest
+
+from chandy_lamport_tpu.core.spec import (
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.models.workloads import (
+    ServeRequest,
+    burst_workload,
+    crash_schedule,
+    ring_topology,
+    serve_workload,
+)
+from chandy_lamport_tpu.serving.admission import shed_order
+from chandy_lamport_tpu.serving.fleet import (
+    _exit_provenance,
+    fleet_run,
+    recipe_runner,
+    worker_serve,
+)
+from chandy_lamport_tpu.serving.spool import AdmissionSpool
+
+
+def _req(job, arrival=0, tenant=0, priority=1, slack=32, tokens=2):
+    return ServeRequest(
+        job=job, arrival_step=arrival, tenant=tenant, priority=priority,
+        deadline_step=arrival + slack,
+        events=[PassTokenEvent(src="N1", dest="N2", tokens=tokens),
+                SnapshotEvent(node_id="N3"), TickEvent(4)])
+
+
+class TestHostLogic:
+    def test_shed_order_drops_least_urgent_first(self):
+        reqs = [
+            ServeRequest(0, 0, 0, 1, 100, []),   # high class
+            ServeRequest(1, 0, 0, 0, 50, []),    # low class, tight
+            ServeRequest(2, 0, 0, 0, 90, []),    # low class, slack
+            ServeRequest(3, 5, 0, 0, 90, []),    # ... later arrival
+        ]
+        order = [r.job for r in shed_order(reqs)]
+        # lowest priority first; within it the latest deadline (most
+        # slack) first, then the latest arrival; high class dies last
+        assert order == [3, 2, 1, 0]
+
+    def test_shed_order_mirrors_edf_admission(self):
+        reqs = serve_workload(ring_topology(4), 8, seed=5, priorities=3)
+        shed = [r.job for r in shed_order(reqs)]
+        from chandy_lamport_tpu.serving.admission import order_eligible
+        admit = [r.job for r in order_eligible(reqs, "edf")]
+        # the job shed FIRST is never the one EDF would admit first
+        assert shed[0] != admit[0]
+        assert sorted(shed) == sorted(admit)
+
+    def test_recipe_runner_null_forms(self):
+        assert recipe_runner(None) is None
+        assert recipe_runner({}) is None
+        assert recipe_runner({"kind": "null"}) is None
+        with pytest.raises(ValueError, match="unknown worker recipe"):
+            recipe_runner({"kind": "warp-drive"})
+
+    def test_exit_provenance_decodes_signals(self):
+        import signal
+
+        assert "SIGKILL" in _exit_provenance(-int(signal.SIGKILL))
+        assert _exit_provenance(0) == "exited with code 0"
+        assert _exit_provenance(None) == "still running"
+        assert "signal 250" in _exit_provenance(-250)
+
+    def test_burst_workload_keeps_clock_and_slack(self):
+        spec = ring_topology(4)
+        base = serve_workload(spec, 12, seed=7, rate=1.0)
+        burst = burst_workload(spec, 12, seed=7, rate=1.0,
+                               burst_period=16, burst_factor=8.0)
+        arrivals = [r.arrival_step for r in burst]
+        assert arrivals == sorted(arrivals)      # monotone clock
+        for b, o in zip(burst, base):
+            # re-timing preserves payload and the deadline SLACK
+            assert b.events == o.events
+            assert (b.deadline_step - b.arrival_step
+                    == o.deadline_step - o.arrival_step)
+        # bursts actually compress: some inter-arrival gap in the burst
+        # half beats the uniform trace's mean gap
+        gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+        assert min(gaps) == 0 or min(gaps) < max(gaps)
+
+    def test_crash_schedule(self):
+        assert crash_schedule(3, 2.0, start_s=1.0) == [1.0, 3.0, 5.0]
+        assert crash_schedule(0, 2.0) == []
+
+
+class TestWorkerLoop:
+    def test_null_worker_serves_everything(self, tmp_path):
+        spool = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        for j in range(5):
+            spool.admit(_req(j, arrival=j))
+        books = worker_serve("w0", spool, None, lease_limit=2,
+                             max_wall_s=30)
+        assert books["served"] == 5 and books["leased"] == 5
+        assert books["late_rejected"] == 0
+        assert spool.finished()
+        assert spool.results()[0]["served_from"] == "null"
+
+    def test_reclaimed_lease_result_is_discarded(self, tmp_path):
+        spool = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=5.0)
+        spool.admit(_req(0))
+        # simulate the stalled worker: its lease is reclaimed and the
+        # job redelivered to (and completed by) the takeover before the
+        # original's commit arrives
+        spool.lease("w-slow", limit=1, now=0.0)
+        spool.reclaim_expired(now=10.0)
+        spool.lease("w-takeover", limit=1, now=11.0)
+        assert spool.complete(0, "w-takeover", {"t": 1}, now=12.0)
+        assert spool.complete(0, "w-slow", {"t": 1}, now=13.0) is False
+        assert spool.done_by[0] == "w-takeover"
+
+    def test_inprocess_worker_bit_identical_to_solo(
+            self, tmp_path, ring8_sync_stream_runner):
+        # the tier-1 identity sentinel: the worker loop in THIS process
+        # against the shared session runner — every served summary must
+        # equal a solo singleton run_stream of the same request (the
+        # multiprocess version of this proof lives in chaos_smoke's
+        # fleet-kill-takeover scenario)
+        runner = ring8_sync_stream_runner
+        reqs = [_req(j, arrival=j, tokens=j + 1) for j in range(3)]
+        spool = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        for r in reqs:
+            spool.admit(r)
+        books = worker_serve("w0", spool, runner, lease_limit=2,
+                             max_wall_s=60)
+        assert books["served"] == 3
+        assert spool.finished()
+        for j, row in spool.results().items():
+            pool = runner.pack_jobs([reqs[j].events], content_keys=True)
+            _, stream = runner.run_stream(pool, stretch=2, drain_chunk=8)
+            (solo,) = runner.stream_results(stream)
+            solo = {k: v for k, v in solo.items()
+                    if k not in ("job", "admit_step")}
+            got = {k: v for k, v in row.items()
+                   if k not in ("digest", "served_from")}
+            assert got == solo, j
+            assert row["served_from"] == "fleet-exec"
+
+    def test_duplicate_content_served_from_shared_cache(
+            self, tmp_path, ring8_sync_stream_runner):
+        import copy
+
+        # a second worker handle sharing the memo file must answer a
+        # digest the first already served from the cache, no lane burned
+        runner = copy.copy(ring8_sync_stream_runner)
+        runner.memo_cache_path = str(tmp_path / "memo.jsonl")
+        reqs = [_req(0, tokens=7), _req(1, tokens=7)]   # same content
+        spool = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        spool.admit(reqs[0])
+        b0 = worker_serve("w0", spool, runner, lease_limit=1,
+                          max_wall_s=60)
+        spool.admit(reqs[1])
+        b1 = worker_serve("w1", spool, runner, lease_limit=1,
+                          max_wall_s=60)
+        assert b0["cache_served"] == 0 and b1["cache_served"] == 1
+        res = spool.results()
+        assert res[0]["served_from"] == "fleet-exec"
+        assert res[1]["served_from"] == "fleet-cache"
+        a = {k: v for k, v in res[0].items() if k != "served_from"}
+        b = {k: v for k, v in res[1].items() if k != "served_from"}
+        assert a == b                       # identical bytes, same digest
+
+
+class TestFleetRun:
+    def test_one_null_worker_fleet(self, tmp_path):
+        # the real spawn plumbing once in tier-1: one process, null
+        # executor, everything served, books and audit conserved
+        reqs = [_req(j, arrival=j) for j in range(4)]
+        rep = fleet_run(reqs, spool_path=str(tmp_path / "wal.jsonl"),
+                        workers=1, recipe=None, lease_ttl=5.0,
+                        max_wall_s=60)
+        assert rep["served"] == 4 and rep["goodput"] == 1.0
+        assert rep["audit"]["lost"] == 0
+        assert rep["audit"]["double_served"] == 0
+        assert rep["books"]["worker_deaths"] == 0
+        assert not rep["timed_out"]
+        assert rep["serve_schema"] >= 1
+        assert rep["lat_p50_s"] is not None
+
+    def test_rejects_zero_workers(self, tmp_path):
+        with pytest.raises(ValueError, match="workers"):
+            fleet_run([], spool_path=str(tmp_path / "w.jsonl"), workers=0)
+
+    def test_shed_happens_before_spawn(self, tmp_path):
+        # admission-time pressure control: victims are exactly
+        # shed_order's prediction, decided before any worker races
+        reqs = serve_workload(ring_topology(4), 8, seed=3, rate=4.0,
+                              priorities=3)
+        rep = fleet_run(reqs, spool_path=str(tmp_path / "wal.jsonl"),
+                        workers=1, recipe=None, shed_backlog=3,
+                        max_wall_s=60)
+        victims = sorted(r.job for r in shed_order(reqs)[:5])
+        assert sorted(int(j) for j in rep["shed"]) == victims
+        assert rep["served"] == 3
+        assert rep["books"]["shed"] == 5
+
+    @pytest.mark.slow
+    def test_multiworker_fleet_with_injected_crash(self, tmp_path):
+        # the full differential: two REAL engine workers, one injected
+        # SIGKILL from the supervisor's crash schedule, bit-identity and
+        # conservation at the end (the scheduled cousin of chaos_smoke's
+        # deterministic kill-on-lease scenario)
+        spec = ring_topology(8, tokens=16)
+        reqs = serve_workload(spec, 6, seed=13, rate=2.0, tenants=2,
+                              priorities=3, max_phases=4,
+                              deadline_slack=(8, 64))
+        recipe = {"kind": "ring-stream", "n": 8, "tokens": 16,
+                  "snapshots": 2, "max_recorded": 32, "batch": 2,
+                  "scheduler": "sync",
+                  "memo_cache": str(tmp_path / "memo.jsonl")}
+        rep = fleet_run(reqs, spool_path=str(tmp_path / "wal.jsonl"),
+                        workers=2, recipe=recipe, lease_ttl=4.0,
+                        crash_schedule=crash_schedule(1, 1.0, start_s=4.0),
+                        restart_backoff=0.2, max_wall_s=180)
+        assert rep["served"] == 6
+        assert rep["books"]["injected_kills"] == 1
+        assert rep["books"]["worker_deaths"] >= 1
+        assert rep["audit"]["lost"] == 0
+        assert rep["audit"]["double_served"] == 0
+        solo = recipe_runner({**recipe, "memo_cache": None})
+        for j, row in rep["results"].items():
+            pool = solo.pack_jobs([reqs[int(j)].events],
+                                  content_keys=True)
+            _, stream = solo.run_stream(pool, stretch=2, drain_chunk=8)
+            (srow,) = solo.stream_results(stream)
+            srow = {k: v for k, v in srow.items()
+                    if k not in ("job", "admit_step")}
+            got = {k: v for k, v in row.items()
+                   if k not in ("digest", "served_from")}
+            assert got == srow, j

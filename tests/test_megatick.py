@@ -135,19 +135,17 @@ _GOLDEN_IDS = [events.removesuffix(".events")
                for _, events, _ in REFERENCE_TESTS]
 
 
-_BIG_GOLDENS = {"3nodes-bidirectional-messages",
-                "8nodes-sequential-snapshots", "8nodes-concurrent-snapshots",
-                "10nodes"}
+_TIER1_GOLDENS = {"3nodes-simple"}
 
 
 @pytest.mark.parametrize(
     "top,events",
-    # the four big-fixture cases are ~60s of compile between them; the
-    # small fixtures + the hash-delay lane-0 test below keep the wave-vs-
-    # cascade differential in tier-1, the big four run in full passes
-    [pytest.param(t, e, marks=([pytest.mark.slow]
-                               if e.removesuffix(".events") in _BIG_GOLDENS
-                               else []))
+    # each golden case costs a ~8-15s compile; one representative small
+    # fixture + the hash-delay lane-0 test below keep the wave-vs-cascade
+    # differential in tier-1, the other six goldens run in full passes
+    [pytest.param(t, e, marks=([]
+                               if e.removesuffix(".events") in _TIER1_GOLDENS
+                               else [pytest.mark.slow]))
      for t, e, _ in REFERENCE_TESTS],
     ids=_GOLDEN_IDS)
 def test_batched_wave_matches_sequential_cascade_on_goldens(top, events):
@@ -177,6 +175,8 @@ def test_batched_wave_matches_sequential_cascade_on_goldens(top, events):
             jax.tree_util.tree_map(lambda x: x[lane], final), ref)
 
 
+@pytest.mark.slow  # ~11 s; the 3nodes-simple golden above + the hash-delay
+# summary test in test_hash_delay keep both claims in tier-1
 def test_batched_wave_matches_cascade_on_goldens_hash_lane0():
     """Same scripts under the production hash sampler (per-lane streams):
     lane 0 reproduces the single-instance stream exactly, so the batched

@@ -166,6 +166,7 @@ def test_fused_vmem_budget_math():
 # bit-identity: fused vs split (the shared fixture pays the compile once)
 
 
+@pytest.mark.slow  # ~13 s; the golden oracle + past-quiescence tests keep fused==split tier-1
 def test_fused_matches_split_run_and_drain(fused_pair10):
     split, fused, s = fused_pair10
     _assert_identical(fused.run_ticks(s, np.int32(9)),

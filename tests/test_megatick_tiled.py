@@ -192,6 +192,9 @@ def test_ring_heads_matches_gather():
 # differentials: tier-1 sentinels
 
 
+@pytest.mark.slow  # ~17 s (the only tier-1 tiled compile); the serve-report
+# stamp test below keeps a tiled smoke in tier-1, the seam differential and
+# the full sweep run in full passes
 def test_tiled_supervised_seam_sentinel():
     """THE tier-1 tiled sentinel, one compile pair for two claims:
     block_edges=5 on the 21-edge graph puts ring-block seams at edges

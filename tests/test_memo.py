@@ -155,6 +155,8 @@ def test_coalesced_rows_bit_identical_to_off(coalesced_run, off_rows):
                and len(r["digest"]) == 64 for r in served)
 
 
+@pytest.mark.slow  # ~10 s; cross-run warm serving stays tier-1 via the
+# serving-layer warm-summary-at-ingest test
 def test_warm_cache_serves_across_runs(admit_runner, pool, off_rows,
                                        coalesced_run):
     # the cold run flushed the cache file; a second run of the same
@@ -275,6 +277,7 @@ def test_cache_rejects_negative_bounds(tmp_path):
         SummaryCache(None, max_bytes=-1)
 
 
+@pytest.mark.slow  # ~7 s; per-key eviction is also pinned by the serving exec-cache tests
 def test_runner_surfaces_eviction_counters(tmp_path, pool, off_rows):
     # a bounded runner reports its cache evictions through the memo books
     cache = str(tmp_path / "tiny.jsonl")

@@ -119,6 +119,7 @@ def test_load_stale_format_version_raises_checkpoint_error(
         load_state(path, r.init_batch())
 
 
+@pytest.mark.slow  # ~14 s; the v5 supervisor-leaf roundtrip keeps ckpt leaves in tier-1
 def test_roundtrip_carries_fault_leaves(tmp_path):
     # format v4: the adversary's stream keys and books survive the disk
     # trip, so a resumed faulted run replays the SAME fault program

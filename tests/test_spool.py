@@ -1,0 +1,383 @@
+"""The write-ahead admission spool (serving/spool.py): exactly-once
+intake under crashes.
+
+Host-only and jax-free on the parent side: every transaction is decided
+against the replayed journal under the advisory lock, so the whole
+contract — idempotent admit, lease/renew/complete, expiry redelivery,
+poison quarantine, shedding, the conservation audit — is testable with
+an injectable clock and no engine. The crash windows themselves are
+exercised for real: subprocess children killed by SIGKILL at the named
+atomicio failpoints (CLSIM_IO_FAILPOINT) inside the tmp-write/replace
+and append windows, with the survivor files then re-validated strictly.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from chandy_lamport_tpu.core.spec import (
+    PassTokenEvent,
+    SnapshotEvent,
+    TickEvent,
+)
+from chandy_lamport_tpu.models.workloads import ServeRequest
+from chandy_lamport_tpu.serving.spool import (
+    WAL_SCHEMA_VERSION,
+    AdmissionSpool,
+    SpoolError,
+    decode_events,
+    decode_request,
+    encode_events,
+    encode_request,
+    request_digest,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(job, arrival=0, tenant=0, priority=1, slack=32, tokens=2):
+    return ServeRequest(
+        job=job, arrival_step=arrival, tenant=tenant, priority=priority,
+        deadline_step=arrival + slack,
+        events=[PassTokenEvent(src="n0", dest="n1", tokens=tokens),
+                SnapshotEvent(node_id="n0"), TickEvent(3)])
+
+
+class TestEncoding:
+    def test_event_roundtrip(self):
+        evs = [PassTokenEvent(src="a", dest="b", tokens=5),
+               SnapshotEvent(node_id="c"), TickEvent(7)]
+        assert decode_events(encode_events(evs)) == evs
+
+    def test_request_roundtrip(self):
+        r = _req(3, arrival=9, tenant=2, priority=0)
+        assert decode_request(encode_request(r)) == r
+
+    def test_unknown_event_rows_refused(self):
+        with pytest.raises(SpoolError):
+            encode_events([object()])
+        with pytest.raises(SpoolError):
+            decode_events([["warp", 1]])
+
+    def test_digest_is_content_pure_and_jax_free(self):
+        assert request_digest(_req(0)) == request_digest(_req(0))
+        assert request_digest(_req(0)) != request_digest(_req(0, tokens=3))
+        # job id participates (it is the WAL's primary key)
+        assert request_digest(_req(0)) != request_digest(_req(1))
+
+
+class TestTransactions:
+    def test_admit_ack_is_idempotent(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        assert sp.admit(_req(0)) is True
+        # the crashed-ack re-send: same payload, no second record
+        assert sp.admit(_req(0)) is False
+        assert sp.counters()["admitted"] == 1
+
+    def test_admit_alias_refused(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        sp.admit(_req(0))
+        with pytest.raises(SpoolError, match="different digest"):
+            sp.admit(_req(0, tokens=9))
+
+    def test_lease_order_and_exactly_once_complete(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        for j, arr in ((0, 5), (1, 0), (2, 3)):
+            sp.admit(_req(j, arrival=arr))
+        got = sp.lease("w0", limit=2, now=100.0)
+        # deterministic (arrival, job) order, not admit order
+        assert [r.job for r in got] == [1, 2]
+        assert sp.complete(1, "w0", {"t": 1}, now=101.0) is True
+        # second commit of a terminal job is refused, not double-served
+        assert sp.complete(1, "w0", {"t": 1}, now=102.0) is False
+        # a worker without the lease cannot commit
+        assert sp.complete(2, "w9", {"t": 2}, now=102.0) is False
+        assert sp.pending() == [0]
+
+    def test_renew_extends_only_own_live_leases(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=10.0)
+        sp.admit(_req(0))
+        sp.admit(_req(1))
+        sp.lease("w0", limit=1, now=0.0)
+        assert sp.renew("w0", [0, 1], now=5.0) == [0]
+        assert sp.leases[0]["expires"] == pytest.approx(15.0)
+        assert sp.renew("w1", [0], now=5.0) == []
+
+    def test_expiry_requeue_takeover_and_late_commit(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=10.0)
+        sp.admit(_req(0))
+        sp.lease("w0", limit=1, now=0.0)
+        # nothing expires while the heartbeat horizon holds
+        assert sp.reclaim_expired(now=9.0) == {"requeued": [],
+                                               "poisoned": []}
+        out = sp.reclaim_expired(now=11.0)
+        assert out["requeued"] == [0]
+        (takeover,) = sp.lease("w1", limit=1, now=12.0)
+        assert takeover.job == 0
+        # the dead-but-slow original worker's late result is discarded
+        assert sp.complete(0, "w0", {"t": 0}, now=13.0) is False
+        assert sp.complete(0, "w1", {"t": 0}, now=13.0) is True
+        assert sp.done_by[0] == "w1"
+        assert sp.books["requeues"] == 1
+
+    def test_poison_after_attempt_budget_with_provenance(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=10.0,
+                            max_attempts=2)
+        sp.admit(_req(0))
+        sp.lease("w0", limit=1, now=0.0)
+        assert sp.reclaim_expired(now=11.0)["requeued"] == [0]
+        sp.lease("w1", limit=1, now=12.0)
+        out = sp.reclaim_expired(now=23.0)
+        assert out["poisoned"] == [0]
+        trail = sp.poisoned[0]["errors"]
+        assert len(trail) == 2
+        assert "w0" in trail[0] and "attempt 1/2" in trail[0]
+        assert "w1" in trail[1] and "attempt 2/2" in trail[1]
+        assert sp.poisoned[0]["attempts"] == 2
+        # terminal: not leasable, not completable
+        assert sp.lease("w2", limit=1, now=24.0) == []
+        assert sp.complete(0, "w1", {"t": 0}, now=24.0) is False
+        assert sp.finished()
+
+    def test_requeue_worker_is_the_fast_death_path(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=1000.0)
+        sp.admit(_req(0))
+        sp.admit(_req(1))
+        sp.lease("w0", limit=2, now=0.0)
+        out = sp.requeue_worker("w0", "worker w0 killed by SIGKILL",
+                                now=1.0)
+        assert out["requeued"] == [0, 1]
+        assert sp.pending() == [0, 1]
+        assert sp.errors[0] == ["worker w0 killed by SIGKILL"]
+
+    def test_fail_releases_lease_and_records_provenance(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        sp.admit(_req(0))
+        sp.lease("w0", limit=1, now=0.0)
+        sp.fail(0, "w0", "compile exploded", now=1.0)
+        assert sp.pending() == [0]
+        assert sp.errors[0] == ["compile exploded"]
+        # fail from a non-holder is a no-op
+        sp.lease("w1", limit=1, now=2.0)
+        sp.fail(0, "w0", "late report", now=3.0)
+        assert sp.leases[0]["worker"] == "w1"
+
+    def test_shed_drops_pending_only(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"))
+        for j in range(3):
+            sp.admit(_req(j))
+        sp.lease("w0", limit=1, now=0.0)
+        done = sp.shed_jobs([0, 1, 2], "backlog over capacity", now=1.0)
+        assert done == [1, 2]          # job 0 is leased, never shed
+        assert sp.shed == {1: "backlog over capacity",
+                           2: "backlog over capacity"}
+
+    def test_audit_conservation(self, tmp_path):
+        sp = AdmissionSpool(str(tmp_path / "wal.jsonl"), lease_ttl=10.0,
+                            max_attempts=1)
+        for j in range(4):
+            sp.admit(_req(j, arrival=j))
+        sp.lease("w0", limit=1, now=0.0)
+        sp.complete(0, "w0", {"t": 0}, now=1.0)
+        sp.lease("w1", limit=1, now=2.0)
+        sp.reclaim_expired(now=13.0)           # poisons job 1 (budget 1)
+        sp.shed_jobs([3], "pressure", now=14.0)
+        audit = sp.audit()
+        assert audit["admitted"] == 4
+        assert audit["served"] == 1 and audit["poisoned"] == 1
+        assert audit["shed"] == 1 and audit["pending"] == 1
+        assert audit["lost"] == 0 and audit["double_served"] == 0
+        assert audit["digests_ok"]
+
+
+class TestWalReplay:
+    def test_rescan_is_idempotent_across_handles(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        sp = AdmissionSpool(path, lease_ttl=10.0)
+        for j in range(3):
+            sp.admit(_req(j, arrival=j))
+        sp.lease("w0", limit=1, now=0.0)
+        sp.complete(0, "w0", {"tokens": [1, 2]}, now=1.0)
+        size = os.path.getsize(path)
+        for _ in range(3):                     # fresh crash-restart scans
+            fresh = AdmissionSpool(path, lease_ttl=10.0)
+            assert fresh.done == {0: {"tokens": [1, 2]}}
+            assert fresh.pending() == [1, 2]
+            assert fresh.books["torn_tail_truncated"] == 0
+        # replay never rewrites history
+        assert os.path.getsize(path) == size
+
+    def test_cross_handle_visibility(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        a = AdmissionSpool(path)
+        b = AdmissionSpool(path)
+        a.admit(_req(0))
+        # b's next transaction replays a's append before deciding
+        assert b.lease("wb", limit=1, now=0.0)[0].job == 0
+
+    def test_torn_tail_truncated_and_skipped(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        sp = AdmissionSpool(path)
+        sp.admit(_req(0))
+        size = os.path.getsize(path)
+        with open(path, "ab") as f:
+            f.write(b'{"wal_schema": 1, "kind": "admit", "jo')
+        fresh = AdmissionSpool(path)
+        assert fresh.books["torn_tail_truncated"] == 1
+        assert list(fresh.requests) == [0]
+        # the torn bytes are gone — the next append lands on a boundary
+        assert os.path.getsize(path) == size
+        assert fresh.admit(_req(1)) is True
+        again = AdmissionSpool(path)
+        assert sorted(again.requests) == [0, 1]
+        assert again.books["torn_tail_truncated"] == 0
+
+    def test_mid_file_damage_is_refused(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        sp = AdmissionSpool(path)
+        sp.admit(_req(0))
+        rec = {"wal_schema": WAL_SCHEMA_VERSION, "kind": "shed",
+               "job": 0, "reason": "x", "t": 1.0}
+        with open(path, "ab") as f:
+            f.write(b"@@not json@@\n")         # complete line, mid-file
+            f.write((json.dumps(rec) + "\n").encode())
+        # a newline-terminated unparsable line is NOT a torn append —
+        # refuse loudly rather than skip it (it could be a lost record)
+        with pytest.raises(SpoolError, match="corrupt record at byte"):
+            AdmissionSpool(path)
+        # the already-open handle hits it on its next transaction too
+        with pytest.raises(SpoolError, match="corrupt record at byte"):
+            sp.admit(_req(1))
+
+    def test_stale_schema_is_refused_by_name(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        rec = {"wal_schema": WAL_SCHEMA_VERSION + 1, "kind": "admit",
+               "job": 0, "digest": "0" * 64,
+               "request": encode_request(_req(0)), "t": 0.0}
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+        with pytest.raises(SpoolError) as err:
+            AdmissionSpool(path)
+        assert "wal_schema" in str(err.value)
+        assert path in str(err.value)
+
+    def test_double_done_is_structurally_refused(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        sp = AdmissionSpool(path)
+        sp.admit(_req(0))
+        sp.lease("w0", limit=1, now=0.0)
+        sp.complete(0, "w0", {"t": 0}, now=1.0)
+        done = {"wal_schema": WAL_SCHEMA_VERSION, "kind": "done",
+                "job": 0, "worker": "w1", "summary": {"t": 0}, "t": 2.0}
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(done) + "\n")
+        with pytest.raises(SpoolError, match="double-serve"):
+            AdmissionSpool(path)
+
+    def test_record_for_unknown_job_refused(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        rec = {"wal_schema": WAL_SCHEMA_VERSION, "kind": "lease",
+               "job": 7, "worker": "w0", "expires": 1.0, "attempt": 1,
+               "t": 0.0}
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(rec) + "\n")
+        with pytest.raises(SpoolError, match="never admitted"):
+            AdmissionSpool(path)
+
+
+def _run_child(code: str, failpoint: str) -> subprocess.CompletedProcess:
+    env = {**os.environ, "CLSIM_IO_FAILPOINT": failpoint}
+    return subprocess.run([sys.executable, "-c", textwrap.dedent(code)],
+                          env=env, stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE, timeout=60)
+
+
+class TestKillInTheWindow:
+    """SIGKILL inside the durable-write windows (utils/atomicio
+    failpoints): the survivor file must be the complete OLD state, and
+    the next writer must succeed — the crash window between tmp-write
+    and os.replace leaks nothing but a stale tmp file."""
+
+    def test_memocache_kill_between_tmp_and_replace(self, tmp_path):
+        path = str(tmp_path / "memo.jsonl")
+        from chandy_lamport_tpu.utils.memocache import SummaryCache
+        old = SummaryCache(path)
+        old.put("a" * 64, {"tokens": [1]})
+        old.flush()
+        before = open(path, encoding="utf-8").read()
+        proc = _run_child(f"""
+            import sys
+            sys.path.insert(0, {ROOT!r})
+            from chandy_lamport_tpu.utils.memocache import SummaryCache
+            c = SummaryCache({path!r})
+            c.put("b" * 64, {{"tokens": [2]}})
+            c.flush()
+            raise SystemExit(3)   # unreachable: the failpoint kills us
+        """, "memocache-replace")
+        assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+        # the published name still carries the complete old state...
+        assert open(path, encoding="utf-8").read() == before
+        # ...while the orphan tmp proves the kill landed inside the
+        # window (bytes written and fsynced, name never committed)
+        assert os.path.exists(path + ".tmp")
+        assert '"b' in open(path + ".tmp", encoding="utf-8").read()
+        # and the next writer recovers: strict load + merge + replace
+        nxt = SummaryCache(path)
+        assert nxt.get("a" * 64) == {"tokens": [1]}
+        assert nxt.get("b" * 64) is None
+        nxt.put("c" * 64, {"tokens": [3]})
+        nxt.flush()
+        assert SummaryCache(path).get("c" * 64) == {"tokens": [3]}
+
+    def test_spool_kill_before_append_never_acks(self, tmp_path):
+        path = str(tmp_path / "wal.jsonl")
+        sp = AdmissionSpool(path)
+        sp.admit(_req(0))
+        size = os.path.getsize(path)
+        proc = _run_child(f"""
+            import sys
+            sys.path.insert(0, {ROOT!r})
+            from chandy_lamport_tpu.serving.spool import AdmissionSpool
+            from chandy_lamport_tpu.models.workloads import ServeRequest
+            from chandy_lamport_tpu.core.spec import TickEvent
+            sp = AdmissionSpool({path!r})
+            sp.admit(ServeRequest(job=1, arrival_step=0, tenant=0,
+                                  priority=1, deadline_step=32,
+                                  events=[TickEvent(3)]))
+            raise SystemExit(3)   # unreachable
+        """, "spool-append")
+        assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+        # the ack never returned, so the journal must not carry job 1 —
+        # and must still be on a record boundary
+        assert os.path.getsize(path) == size
+        fresh = AdmissionSpool(path)
+        assert list(fresh.requests) == [0]
+        assert fresh.books["torn_tail_truncated"] == 0
+        # the caller's contract: retry the admit; it lands cleanly
+        assert fresh.admit(_req(1)) is True
+
+    def test_checkpoint_kill_between_tmp_and_replace(self, tmp_path):
+        path = str(tmp_path / "state.ckpt")
+        proc = _run_child(f"""
+            import os, sys
+            sys.path.insert(0, {ROOT!r})
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            from chandy_lamport_tpu.config import SimConfig
+            from chandy_lamport_tpu.core.state import (DenseTopology,
+                                                       init_state)
+            from chandy_lamport_tpu.models.workloads import ring_topology
+            from chandy_lamport_tpu.utils import checkpoint
+            topo = DenseTopology(ring_topology(4))
+            state = init_state(topo, SimConfig(), None)
+            checkpoint.save_state({path!r}, state)
+            raise SystemExit(3)   # unreachable
+        """, "checkpoint-replace")
+        assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+        # the name was never committed: no torn checkpoint to mis-load
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".tmp")

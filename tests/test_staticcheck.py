@@ -16,6 +16,8 @@ from tools.staticcheck.jaxpr_audit import load_registry
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow  # ~8 s full-tree sweep; the per-rule unit tests below stay
+# tier-1 and `python -m tools.staticcheck --plane ast` runs in full passes
 def test_ast_plane_clean_on_tree():
     # the shipped tree must satisfy its own structural invariants
     # (err-bit registry, knob pattern, ckpt history, scatter modes)
@@ -315,6 +317,84 @@ def test_cache_lock_requires_locked_replace():
     # files outside the shared-cache set are not this rule's business
     assert ast_lint.check_cache_lock({
         "chandy_lamport_tpu/utils/checkpoint.py": bad}) == []
+
+
+def test_wal_append_bans_rewrites_and_unlocked_journal_io():
+    bad = (
+        "import os\n"
+        "from chandy_lamport_tpu.utils.atomicio import fsync_append\n"
+        "def commit(path, tmp, line):\n"
+        "    os.replace(tmp, path)\n"
+        "    with open(path, 'wb') as f:\n"
+        "        f.write(line)\n"
+        "def append(path, line):\n"
+        "    with open(path, 'ab') as f:\n"
+        "        fsync_append(f, line)\n"
+        "def repair(path, off):\n"
+        "    os.truncate(path, off)\n"
+    )
+    vs = ast_lint.check_wal_append({ast_lint.SPOOL_PATH: bad})
+    assert [v.rule for v in vs] == ["wal-append"] * 5, \
+        [v.to_dict() for v in vs]
+    # the rename, the write-mode open, the raw write, the unlocked
+    # append and the unlocked torn-tail truncate — each named by line
+    assert {v.where.split(":")[1] for v in vs} == {"4", "5", "6", "9", "11"}
+    # other files are not this rule's business
+    assert ast_lint.check_wal_append({
+        "chandy_lamport_tpu/utils/checkpoint.py": bad}) == []
+
+
+def test_wal_append_accepts_the_locked_helper_discipline():
+    # the real spool's shape: private mutators touch the journal, their
+    # callers hold the lock — legal in both directions
+    good = (
+        "import os\n"
+        "from chandy_lamport_tpu.utils.atomicio import fsync_append\n"
+        "from chandy_lamport_tpu.utils.filelock import locked\n"
+        "class Spool:\n"
+        "    def _append(self, line):\n"
+        "        with open(self.path, 'ab') as f:\n"
+        "            fsync_append(f, line)\n"
+        "    def _replay(self):\n"
+        "        os.truncate(self.path, 0)\n"
+        "    def admit(self, line):\n"
+        "        with locked(self.path):\n"
+        "            self._replay()\n"
+        "            self._append(line)\n"
+    )
+    assert ast_lint.check_wal_append({ast_lint.SPOOL_PATH: good}) == []
+    # ... but calling a lock-holding helper WITHOUT the lock is flagged
+    naked = (
+        "class Spool:\n"
+        "    def peek(self):\n"
+        "        self._replay()\n"
+    )
+    vs = ast_lint.check_wal_append({ast_lint.SPOOL_PATH: naked})
+    assert len(vs) == 1 and "_replay" in vs[0].detail and \
+        vs[0].where.endswith(":3"), [v.to_dict() for v in vs]
+
+
+def test_wal_append_fsync_helper_must_actually_fsync():
+    lazy = (
+        "def fsync_append(f, data):\n"
+        "    f.write(data)\n"
+        "    f.flush()\n"
+        "    return len(data)\n"
+    )
+    vs = ast_lint.check_wal_append({ast_lint.SPOOL_PATH: "x = 1\n",
+                                    ast_lint.ATOMICIO_PATH: lazy})
+    assert len(vs) == 1 and "os.fsync" in vs[0].detail, \
+        [v.to_dict() for v in vs]
+    good = (
+        "import os\n"
+        "def fsync_append(f, data):\n"
+        "    f.write(data)\n"
+        "    f.flush()\n"
+        "    os.fsync(f.fileno())\n"
+        "    return len(data)\n"
+    )
+    assert ast_lint.check_wal_append({ast_lint.SPOOL_PATH: "x = 1\n",
+                                      ast_lint.ATOMICIO_PATH: good}) == []
 
 
 def test_cost_budget_ceiling_semantics():

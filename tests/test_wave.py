@@ -63,11 +63,12 @@ def _assert_states_identical(a, b):
 
 
 @pytest.mark.parametrize("case_seed", [
-    0,
-    # seed 0 rides tier-1; the rest of the battery runs in full passes
+    # the whole battery runs in full passes; the fixed-delay and
+    # capacity-edge wave-vs-cascade differentials below stay tier-1
     # (the PR-3 re-tiering mechanism — tier-1 lives under a hard
-    # wall-clock budget and each seed costs a ~11 s compile+storm;
-    # seed 1 moved out when the memo-plane tests joined the gate)
+    # wall-clock budget and each seed costs a ~11-16 s compile+storm;
+    # seed 0 moved out when the serving-fleet tests joined the gate)
+    pytest.param(0, marks=pytest.mark.slow),
     pytest.param(1, marks=pytest.mark.slow),
     pytest.param(2, marks=pytest.mark.slow),
     pytest.param(3, marks=pytest.mark.slow)])

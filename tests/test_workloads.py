@@ -80,7 +80,10 @@ def test_storm_matches_per_event_injection_fixed_delay():
 import pytest
 
 
-@pytest.mark.parametrize("scheduler", ["exact", "sync"])
+@pytest.mark.parametrize("scheduler", [
+    # the exact leg costs ~11 s of compile; sync keeps the invariants in
+    # tier-1 and every tier-1 golden differential runs the exact sampler
+    pytest.param("exact", marks=pytest.mark.slow), "sync"])
 def test_storm_scale_invariants(scheduler):
     spec = scale_free(24, 2, seed=5, tokens=200)
     b = 4
@@ -106,6 +109,7 @@ def test_storm_scale_invariants(scheduler):
                     + sum(m.message.data for m in snap.messages) == total0)
 
 
+@pytest.mark.slow  # ~12 s; gather-vs-mask engine equality in test_queue_engine stays tier-1
 def test_sync_scheduler_deterministic():
     """Same seed -> bit-identical final state across independent runs."""
     spec = erdos_renyi(16, 3.0, seed=8, tokens=100)

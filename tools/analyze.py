@@ -25,9 +25,17 @@ bytes / collective traffic per compiled engine arm) and cross-check the
 graphshard dense-vs-sparse collective bytes against the analytic
 ``utils/metrics.comm_bytes_model`` at the audit fixture's cut.
 
+``--slo-ladder FILE`` switches to fleet-ladder mode: read a JSONL stream
+of ``bench --fleet`` rows and print the serving-fleet SLO ladder — the
+worker-count knee curve (served jobs/s, scaling, goodput, latency
+percentiles, the WAL conservation verdict) plus the degraded-mode rows
+(injected worker SIGKILLs) with their throughput retention against the
+clean rung at the same worker count.
+
 Usage: python tools/analyze.py [--nodes N] [--batch B] [--scheduler sync]
        python tools/analyze.py --telemetry runs.jsonl
        python tools/analyze.py --bench-rows rows.jsonl
+       python tools/analyze.py --slo-ladder fleet.jsonl
        python tools/analyze.py --cost
 """
 
@@ -149,6 +157,88 @@ def analyze_bench_rows(path: str) -> None:
           "appear under their RESOLVED engine)")
 
 
+def analyze_slo_ladder(path: str) -> None:
+    """The serving-fleet SLO ladder from ``bench --fleet`` rows (JSONL,
+    one bench.py JSON line per row). Rows are grouped by workload shape
+    (graph, nodes, batch, requests, rate); within a group the CLEAN rows
+    (no injected crashes) are sorted by worker count and printed as the
+    knee curve — served jobs/s, scaling vs the 1-worker rung, goodput and
+    the latency percentiles — followed by the DEGRADED rows (injected
+    SIGKILLs) under their clean baseline with the takeover/restart books
+    and the throughput retention, which is the graceful-degradation
+    number the fleet claims."""
+    import json
+
+    rows, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(r, dict) and \
+                    r.get("metric") == "fleet_served_jobs_per_sec":
+                rows.append(r)
+            else:
+                skipped += 1
+    if not rows:
+        print(f"{path}: no fleet bench rows"
+              + (f" ({skipped} lines skipped)" if skipped else ""))
+        return
+    groups = {}
+    for r in rows:
+        key = (r.get("graph", "?"), r.get("nodes", 0), r.get("batch", 0),
+               r.get("requests", 0), r.get("rate", 0.0))
+        groups.setdefault(key, []).append(r)
+    print(f"{path}: {len(rows)} fleet rows, {len(groups)} workload "
+          f"shapes" + (f" ({skipped} lines skipped)" if skipped else ""))
+    for key in sorted(groups):
+        graph, nodes, batch, reqs, rate = key
+        clean = sorted((r for r in groups[key]
+                        if not r.get("crashes_injected")),
+                       key=lambda r: r.get("workers", 0))
+        degraded = sorted((r for r in groups[key]
+                           if r.get("crashes_injected")),
+                          key=lambda r: r.get("workers", 0))
+        print(f"  {graph} N={nodes} B={batch}, {reqs} requests at "
+              f"rate {rate}/step:")
+        base = clean[0]["value"] if clean and clean[0]["value"] else None
+        print(f"    {'workers':>7} {'jobs/s':>8} {'x1-worker':>9} "
+              f"{'goodput':>7} {'p50 s':>7} {'p99 s':>7} {'audit':>6}")
+        for r in clean:
+            scale = (f"{r['value'] / base:8.2f}x" if base else f"{'—':>9}")
+            audit = ("ok" if not r.get("audit_lost")
+                     and not r.get("audit_double_served") else "FAIL")
+            print(f"    {r.get('workers', 0):>7} {r['value']:>8.2f} "
+                  f"{scale} {r.get('goodput', 0.0):>7.2f} "
+                  f"{_lat(r, 'lat_p50_s')} {_lat(r, 'lat_p99_s')} "
+                  f"{audit:>6}")
+        for r in degraded:
+            peer = next((c for c in clean
+                         if c.get("workers") == r.get("workers")), None)
+            keep = (f"{100.0 * r['value'] / peer['value']:.0f}% of clean"
+                    if peer and peer["value"] else "no clean peer")
+            audit = ("ok" if not r.get("audit_lost")
+                     and not r.get("audit_double_served") else "FAIL")
+            print(f"    {r.get('workers', 0):>7} {r['value']:>8.2f} "
+                  f"  degraded: {r.get('crashes_injected', 0)} kill(s), "
+                  f"{r.get('worker_deaths', 0)} death(s), "
+                  f"{r.get('takeovers', 0)} takeover(s), "
+                  f"{r.get('restarts', 0)} restart(s); {keep}; "
+                  f"goodput {r.get('goodput', 0.0):.2f}; audit {audit}")
+    print("  (value = served jobs/s; audit = WAL conservation: lost=0 "
+          "AND double_served=0)")
+
+
+def _lat(r: dict, key: str) -> str:
+    v = r.get(key)
+    return f"{v:7.2f}" if isinstance(v, (int, float)) else f"{'—':>7}"
+
+
 def analyze_cost() -> None:
     """Modeled-cost comparison across the engine knob matrix, read off the
     pinned ``tools/staticcheck/cost_budgets.json`` rows (no jax, no
@@ -264,6 +354,10 @@ def main() -> None:
                    help="print kernel-engine comparison curves from this "
                         "JSONL stream of bench worker rows instead of "
                         "running the kernel cost analysis")
+    p.add_argument("--slo-ladder", metavar="FILE",
+                   help="print the serving-fleet SLO ladder (worker-count "
+                        "knee curve + degraded-mode retention) from this "
+                        "JSONL stream of bench --fleet rows")
     p.add_argument("--cost", action="store_true",
                    help="print the pinned static cost rows per engine arm "
                         "(tools/staticcheck/cost_budgets.json) plus the "
@@ -275,6 +369,8 @@ def main() -> None:
         return analyze_telemetry(args.telemetry)
     if args.bench_rows:
         return analyze_bench_rows(args.bench_rows)
+    if args.slo_ladder:
+        return analyze_slo_ladder(args.slo_ladder)
     if args.cost:
         return analyze_cost()
 

@@ -23,7 +23,17 @@ Shapes are deliberately tiny (ring-8 / scale-free-16, batch 4) so the whole
 battery — compile included — lands well under 60 s on CPU; this is the
 "did robustness regress" canary, not a soak (tools/soak.py is the battery).
 
-Usage: python tools/chaos_smoke.py [--seed S] [--json]
+The serve-fleet scenarios (clsim-serve-ha, serving/fleet.py) extend the
+battery to PROCESS-level chaos: SIGKILL a worker mid-flight and demand
+lease takeover with zero requests lost or double-served and every served
+summary bit-identical to a solo ``run_stream`` of that request; crash
+every holder of one request until the supervisor quarantines it as
+poison with the full provenance trail; and overload a one-worker fleet
+until deadline-aware shedding drops exactly the predicted victims.
+``--fleet-only`` runs just that trio (the tier-1 slice — the rest of the
+battery is the slow marker).
+
+Usage: python tools/chaos_smoke.py [--seed S] [--fleet-only]
 Prints one verdict line per scenario (stderr) + a JSON summary (stdout);
 exit 0 iff every scenario held every invariant.
 """
@@ -43,17 +53,162 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def fleet_scenarios(seed: int):
+    """The serve-fleet chaos trio (module docstring): returns (rows, ok).
+    Runs REAL multiprocessing workers against a shared WAL spool in a
+    throwaway directory; scenario A pays one jitted engine per worker,
+    B and C ride the jax-free null executor."""
+    import tempfile
+
+    from chandy_lamport_tpu.models.workloads import (
+        ring_topology,
+        serve_workload,
+    )
+    from chandy_lamport_tpu.serving.admission import shed_order
+    from chandy_lamport_tpu.serving.fleet import fleet_run, recipe_runner
+
+    rows, ok = [], True
+    spec = ring_topology(8, tokens=16)
+    reqs = serve_workload(spec, 5, seed=seed + 8, rate=2.0, tenants=2,
+                          priorities=3, max_phases=4, deadline_slack=(8, 64))
+    d = tempfile.mkdtemp(prefix="clsim-fleet-chaos-")
+
+    # -- A: SIGKILL a real worker the moment it leases job 2 (once,
+    #    fleet-wide). The lease must expire, the survivor or the
+    #    restarted worker must take over, and at the end the WAL audit
+    #    must balance: nothing lost, nothing double-served, and every
+    #    served summary bit-identical to a solo run_stream of that
+    #    request (singleton pools pin the content-rank, fleet.py).
+    recipe = {"kind": "ring-stream", "n": 8, "tokens": 16, "snapshots": 2,
+              "max_recorded": 32, "batch": 2, "scheduler": "sync",
+              "memo_cache": os.path.join(d, "memo.jsonl")}
+    counter = os.path.join(d, "kills")
+    rep = fleet_run(reqs, spool_path=os.path.join(d, "takeover.jsonl"),
+                    workers=2, recipe=recipe, lease_ttl=3.0, lease_limit=2,
+                    chaos={"kill_on_job": 2, "kill_limit": 1,
+                           "counter_path": counter},
+                    restart_backoff=0.2, max_wall_s=120)
+    solo = recipe_runner({**recipe, "memo_cache": None})
+    identical = True
+    for j, fs in rep["results"].items():
+        pool = solo.pack_jobs([reqs[int(j)].events], content_keys=True)
+        _, stream = solo.run_stream(pool, stretch=2, drain_chunk=8)
+        (srow,) = solo.stream_results(stream)
+        srow = {k: v for k, v in srow.items()
+                if k not in ("job", "admit_step")}
+        fsumm = {k: v for k, v in fs.items()
+                 if k not in ("digest", "served_from")}
+        identical &= fsumm == srow
+    with open(counter, "r", encoding="utf-8") as f:
+        kills = int(f.read().strip() or 0)
+    audit = rep["audit"]
+    checks = {
+        "all_served": rep["served"] == len(reqs),
+        "none_lost": audit["lost"] == 0,
+        "none_double_served": audit["double_served"] == 0,
+        "digests_intact": audit["digests_ok"],
+        "worker_died": rep["books"]["worker_deaths"] >= 1,
+        "lease_taken_over": rep["books"]["takeovers"] >= 1,
+        "killed_exactly_once": kills == 1,
+        "bit_identical_to_solo": identical,
+    }
+    row = {"scenario": "fleet-kill-takeover", "served": rep["served"],
+           "books": {k: rep["books"][k] for k in
+                     ("takeovers", "worker_deaths", "restarts")},
+           "audit": audit, "checks": checks, "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"fleet-kill-takeover: {'ok' if row['ok'] else 'FAIL'} "
+        f"served={rep['served']} deaths={rep['books']['worker_deaths']} "
+        f"takeovers={rep['books']['takeovers']}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
+    # -- B: crash EVERY holder of job 1 (null executor — pure
+    #    control-plane chaos). After max_attempts the supervisor must
+    #    quarantine it as poison carrying one decoded provenance entry
+    #    per burned attempt, and still serve everything else.
+    rep = fleet_run(reqs, spool_path=os.path.join(d, "poison.jsonl"),
+                    workers=2, recipe=None, lease_ttl=0.5, max_attempts=2,
+                    lease_limit=1,
+                    chaos={"kill_on_job": 1, "kill_limit": 99,
+                           "counter_path": os.path.join(d, "kills-b")},
+                    restart_backoff=0.1, max_wall_s=60)
+    poisoned = {int(k): v for k, v in rep["poisoned"].items()}
+    checks = {
+        "poisoned_exactly_victim": sorted(poisoned) == [1],
+        "provenance_per_attempt": bool(
+            poisoned and len(poisoned[1]["errors"]) == 2
+            and all("SIGKILL" in e for e in poisoned[1]["errors"])),
+        "others_served": rep["served"] == len(reqs) - 1,
+        "none_lost": rep["audit"]["lost"] == 0,
+        "none_double_served": rep["audit"]["double_served"] == 0,
+        "workers_died": rep["books"]["worker_deaths"] >= 2,
+    }
+    row = {"scenario": "fleet-poison-quarantine", "served": rep["served"],
+           "poisoned": poisoned,
+           "books": {k: rep["books"][k] for k in
+                     ("takeovers", "worker_deaths", "restarts")},
+           "audit": rep["audit"], "checks": checks,
+           "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"fleet-poison-quarantine: {'ok' if row['ok'] else 'FAIL'} "
+        f"served={rep['served']} poisoned={sorted(poisoned)}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
+    # -- C: quota pressure — six requests against a one-worker fleet
+    #    whose backlog capacity is two. The four victims must be exactly
+    #    admission.shed_order's prediction (lowest priority class first,
+    #    most slack first within it), shed deterministically at
+    #    admission time, and the books must still balance.
+    shed_reqs = serve_workload(spec, 6, seed=seed + 9, rate=4.0, tenants=2,
+                               priorities=3, max_phases=4,
+                               deadline_slack=(8, 64))
+    rep = fleet_run(shed_reqs, spool_path=os.path.join(d, "shed.jsonl"),
+                    workers=1, recipe=None, lease_ttl=2.0, shed_backlog=2,
+                    max_wall_s=60)
+    victims = sorted(r.job for r in shed_order(shed_reqs)[:4])
+    shed = sorted(int(k) for k in rep["shed"])
+    checks = {
+        "shed_exact_prediction": shed == victims,
+        "survivors_served": rep["served"] == len(shed_reqs) - len(victims),
+        "terminal_conservation": rep["served"] + len(shed)
+        == len(shed_reqs),
+        "none_lost": rep["audit"]["lost"] == 0,
+    }
+    row = {"scenario": "fleet-shed-pressure", "served": rep["served"],
+           "shed": shed, "predicted": victims, "audit": rep["audit"],
+           "checks": checks, "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"fleet-shed-pressure: {'ok' if row['ok'] else 'FAIL'} "
+        f"served={rep['served']} shed={shed} predicted={victims}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+    return rows, ok
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seed", type=int, default=3)
     p.add_argument("--phases", type=int, default=16)
     p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--fleet-only", action="store_true",
+                   help="run only the serve-fleet scenarios (tier-1 slice)")
     args = p.parse_args()
 
     # keep off the real TPU chip when run standalone (same contract as the
     # test conftest); harmless under pytest where conftest already forced it
     if not os.environ.get("CLSIM_KEEP_PLATFORM"):
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.fleet_only:
+        t0 = time.time()
+        rows, ok = fleet_scenarios(args.seed)
+        verdict = {"ok": ok, "scenarios": rows,
+                   "elapsed_s": round(time.time() - t0, 1)}
+        print(json.dumps(verdict))
+        return 0 if ok else 1
+
     import jax
 
     from chandy_lamport_tpu.config import SimConfig
@@ -313,6 +468,10 @@ def main() -> int:
     log(f"trace-under-faults: {'ok' if row['ok'] else 'FAIL'} "
         f"events={rec} retried={lc['retried']}"
         f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
+    frows, fok = fleet_scenarios(args.seed)
+    rows += frows
+    ok &= fok
 
     verdict = {"ok": ok, "scenarios": rows,
                "elapsed_s": round(time.time() - t0, 1)}

@@ -72,6 +72,18 @@ Rules (ids are stable; the README rule table documents them):
                       sit lexically inside a ``with locked(...)`` block
                       (utils/filelock) — an unlocked rename races
                       concurrent writers back to last-writer-wins.
+  wal-append          the admission spool (serving/spool.py) is an
+                      append-only fsynced journal: no ``os.replace``, no
+                      write-mode ``open``, no raw ``.write()`` — durable
+                      bytes go ONLY through utils/atomicio.fsync_append
+                      (whose body must actually ``os.fsync``). Every
+                      ``fsync_append``/``os.truncate`` site and every
+                      call of the lock-holding helpers (``_replay``,
+                      ``_append``, ``_requeue_or_poison``) sits lexically
+                      inside ``with locked(...)`` or inside another
+                      lock-holding helper's body — an unlocked append
+                      interleaves records and an unlocked truncate can
+                      eat a concurrent writer's fsynced tail.
 """
 
 from __future__ import annotations
@@ -90,6 +102,8 @@ BENCH_PATH = "chandy_lamport_tpu/bench.py"
 MEMOCACHE_PATH = "chandy_lamport_tpu/utils/memocache.py"
 SERVING_SERVER_PATH = "chandy_lamport_tpu/serving/server.py"
 SERVING_EXEC_PATH = "chandy_lamport_tpu/serving/executables.py"
+SPOOL_PATH = "chandy_lamport_tpu/serving/spool.py"
+ATOMICIO_PATH = "chandy_lamport_tpu/utils/atomicio.py"
 BATCH_PATH = "chandy_lamport_tpu/parallel/batch.py"
 
 # the memo opt-in ladder; "off" first — the table order IS the contract
@@ -967,6 +981,134 @@ def check_cache_lock(sources: Dict[str, str]) -> List[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# wal-append
+
+# the spool's private mutators whose CALLERS hold the exclusive lock:
+# their bodies may touch fsync_append/os.truncate/each other un-nested,
+# but every call OF them from outside this set must sit lexically inside
+# ``with locked(...)`` — the lexical discipline mirrors cache-lock
+WAL_LOCK_HELPERS = frozenset({"_replay", "_append", "_requeue_or_poison"})
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """The terminal name of a call target (``self._append`` ->
+    ``_append``, ``fsync_append`` -> ``fsync_append``)."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _open_mode(node: ast.Call) -> Optional[str]:
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return None
+    mode = None
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return mode if isinstance(mode, str) else ("r" if mode is None else None)
+
+
+def check_wal_append(sources: Dict[str, str]) -> List[Violation]:
+    """The admission journal is append-only and fsync-disciplined
+    (module docstring): serving/spool.py may not rename, rewrite or
+    raw-``.write()`` the journal — bytes land only via
+    utils/atomicio.fsync_append, and both it and ``os.truncate`` (the
+    torn-tail repair) run under the exclusive lock, either lexically or
+    inside a WAL_LOCK_HELPERS body whose own call sites are checked the
+    same way."""
+    out: List[Violation] = []
+    tree = _parse(sources, SPOOL_PATH)
+    if tree is not None:
+        def visit(node: ast.AST, locked_ctx: bool, fn_name: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_name = node.name
+                locked_ctx = node.name in WAL_LOCK_HELPERS
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                locked_ctx = locked_ctx or any(
+                    _is_locked_ctx(item.context_expr) for item in node.items)
+            elif isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name == "replace" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "os":
+                    out.append(Violation(
+                        "wal-append", f"{SPOOL_PATH}:{node.lineno}",
+                        "os.replace in the spool — the admission journal "
+                        "is append-only; a rename rewrites acknowledged "
+                        "history"))
+                elif name == "write":
+                    out.append(Violation(
+                        "wal-append", f"{SPOOL_PATH}:{node.lineno}",
+                        "raw .write() in the spool — durable journal "
+                        "bytes go only through utils/atomicio."
+                        "fsync_append, so every acknowledged record is "
+                        "on disk before return"))
+                elif name == "open":
+                    mode = _open_mode(node)
+                    if mode is None or any(c in mode for c in "wx+"):
+                        out.append(Violation(
+                            "wal-append", f"{SPOOL_PATH}:{node.lineno}",
+                            f"open(..., {mode!r}) in the spool — only "
+                            f"read ('rb') and append ('ab') modes are "
+                            f"legal on an append-only journal"))
+                elif name == "fsync_append" or (
+                        name == "truncate" and
+                        isinstance(node.func, ast.Attribute) and
+                        isinstance(node.func.value, ast.Name) and
+                        node.func.value.id == "os"):
+                    if not locked_ctx:
+                        out.append(Violation(
+                            "wal-append", f"{SPOOL_PATH}:{node.lineno}",
+                            f"{name} outside the exclusive lock — an "
+                            f"unlocked append interleaves records and an "
+                            f"unlocked truncate can eat a concurrent "
+                            f"writer's fsynced tail; wrap in `with "
+                            f"locked(...)` or a WAL_LOCK_HELPERS body"))
+                elif name in WAL_LOCK_HELPERS and not locked_ctx:
+                    out.append(Violation(
+                        "wal-append", f"{SPOOL_PATH}:{node.lineno}",
+                        f"{name}() called outside `with locked(...)` — "
+                        f"the spool's private mutators assume their "
+                        f"caller holds the exclusive lock"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked_ctx, fn_name)
+
+        visit(tree, False, "")
+
+    atree = _parse(sources, ATOMICIO_PATH)
+    if atree is not None:
+        fsync_fn = None
+        for node in ast.walk(atree):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == "fsync_append":
+                fsync_fn = node
+        if fsync_fn is None:
+            if tree is not None:
+                out.append(Violation(
+                    "wal-append", ATOMICIO_PATH,
+                    "no fsync_append in utils/atomicio.py — the spool's "
+                    "named durable-append helper is missing"))
+        elif not any(
+                isinstance(n, ast.Call) and _call_name(n) == "fsync" and
+                isinstance(n.func, ast.Attribute) and
+                isinstance(n.func.value, ast.Name) and
+                n.func.value.id == "os"
+                for n in ast.walk(fsync_fn)):
+            out.append(Violation(
+                "wal-append", f"{ATOMICIO_PATH}:{fsync_fn.lineno}",
+                "fsync_append does not call os.fsync — without it the "
+                "WAL's returning-IS-the-acknowledgement contract is a "
+                "lie after a power cut"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 ALL_RULES = (
@@ -982,6 +1124,7 @@ ALL_RULES = (
     check_serve_schema,
     check_host_sync,
     check_cache_lock,
+    check_wal_append,
 )
 
 

@@ -25,7 +25,13 @@ Rows (full mode): stream {sync,exact} x memo {off,admit,full} + serve
 stream arm with the in-kernel deadline supervisor armed, and a fused
 serve arm over the exact scheduler — the steady-state loops dispatch
 the one-kernel megatick, proving the fused paths add no host sync or
-retrace). Fast mode keeps one row per loop family for tier-1.
+retrace) + one fleet.worker arm (the HA fleet's in-process serve loop
+over the WAL spool: warm on one spool, steady on a FRESH spool with
+same-shape different-content requests, so every singleton pool re-uses
+the warm executable — the lease/renew/commit bookkeeping is host-side
+by design and runs outside the armed region, but the per-request
+execution must add zero compiles and no sites beyond the stream
+allowlist). Fast mode keeps one row per loop family for tier-1.
 """
 
 from __future__ import annotations
@@ -133,6 +139,54 @@ def _serve_row(key: str, policy: str, scheduler: str = "sync",
     return vs, steps
 
 
+def _fleet_row(key: str) -> Tuple[List[Violation], int]:
+    import os
+    import tempfile
+
+    from chandy_lamport_tpu.core.spec import (
+        PassTokenEvent, SnapshotEvent, TickEvent)
+    from chandy_lamport_tpu.models.workloads import ServeRequest
+    from chandy_lamport_tpu.serving.fleet import worker_serve
+    from chandy_lamport_tpu.serving.spool import AdmissionSpool
+    from chandy_lamport_tpu.utils.guards import RuntimeGuards
+
+    def reqs(tokens0):
+        # same event structure (one singleton-pool shape, so the steady
+        # pass reuses the warm executable) but different token payloads
+        # (different digests, so the shared summary cache cannot answer
+        # and the dispatch path actually runs)
+        return [ServeRequest(
+            job=j, arrival_step=j, tenant=0, priority=1,
+            deadline_step=j + 64,
+            events=[PassTokenEvent(src="N1", dest="N2", tokens=tokens0 + j),
+                    SnapshotEvent(node_id="N3"), TickEvent(4)])
+            for j in range(3)]
+
+    guards = RuntimeGuards()
+    runner = _runner("sync", "off", guards)
+    with tempfile.TemporaryDirectory() as d:
+        warm = AdmissionSpool(os.path.join(d, "warm.jsonl"))
+        for r in reqs(1):
+            warm.admit(r)
+        worker_serve("sentry-warm", warm, runner, lease_limit=2,
+                     max_wall_s=120)                        # warmup
+        guards.reset()
+        steady = AdmissionSpool(os.path.join(d, "steady.jsonl"))
+        for r in reqs(11):
+            steady.admit(r)
+        books = worker_serve("sentry", steady, runner, lease_limit=2,
+                             max_wall_s=120)
+    served = int(books["served"])
+    vs = _check_books(key, guards.books(), STREAM_SITES, served)
+    if books["cache_served"] or served != 3:
+        vs.append(Violation(
+            "runtime-retrace", key,
+            f"steady-state fleet pass did not dispatch every request "
+            f"(served={served}, cache_served={books['cache_served']}) — "
+            f"the row proved nothing about the worker's execution path"))
+    return vs, served
+
+
 def _graphshard_row(key: str) -> Tuple[List[Violation], int]:
     import numpy as np
     from jax.sharding import Mesh
@@ -206,6 +260,13 @@ def iter_rows(mode: str = "full"):
              lambda: _serve_row("serve.edf.fused", "edf",
                                 scheduler="exact", kernel_engine="pallas",
                                 fused_tick="on")),
+            # the HA fleet's worker loop (serving/fleet.py) in-process:
+            # singleton pools over the WAL spool must reuse the warm
+            # executable across requests and add no sync beyond the
+            # stream sites — the WAL's own fsync bookkeeping is host-side
+            # and runs outside the armed run_stream region by design
+            ("fleet.worker",
+             lambda: _fleet_row("fleet.worker")),
         ]
     return rows
 
