@@ -319,11 +319,22 @@ def _parser() -> argparse.ArgumentParser:
                    help="--stream: fraction of the queue that repeats a "
                         "Zipf-drawn scenario-library job byte-for-byte "
                         "(models/workloads.stream_jobs dup_rate)")
-    p.add_argument("--memo", choices=["off", "admit", "full"], default="off",
+    p.add_argument("--prefix-overlap", type=float, default=0.0, metavar="R",
+                   help="--stream: fraction of the queue that extends a "
+                        "Zipf-drawn library job with a distinguishing "
+                        "tail — near-duplicates the exact-match memo "
+                        "plane cannot serve but memo=prefix can fork "
+                        "(models/workloads.stream_jobs prefix_overlap; "
+                        "mutually exclusive with --dup-rate)")
+    p.add_argument("--memo", choices=["off", "admit", "full", "prefix"],
+                   default="off",
                    help="--stream: ALSO drive the queue through the memo "
                         "plane at this level and report effective jobs/s "
-                        "(served = executed + coalesced) A/B against the "
-                        "memo-off arm on the same content-keyed pool")
+                        "(served = executed + coalesced + forked) A/B "
+                        "against the memo-off arm on the same "
+                        "content-keyed pool; memo=prefix additionally "
+                        "runs a memo=full arm so prefix_speedup isolates "
+                        "the fork plane's win over exact-match memo")
     p.add_argument("--serve", action="store_true",
                    help="measure the online serving front-end "
                         "(chandy_lamport_tpu/serving.serve_run) instead of "
@@ -892,16 +903,34 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=17, base_phases=4,
                        tail_alpha=1.1, max_phases=max(args.phases, 8),
-                       dup_rate=args.dup_rate)
-    # memo A/B fairness: BOTH arms run the identical content-keyed pool
-    # (duplicate jobs share delay/fault rows), so the only difference
-    # between them is the memo plane itself
-    pool = runner.pack_jobs(jobs,
+                       dup_rate=args.dup_rate,
+                       prefix_overlap=args.prefix_overlap)
+    # memo A/B fairness: EVERY arm runs the identical content-keyed pool,
+    # so the only difference between arms is the memo plane itself. Under
+    # memo=prefix the PREFIX runner must pack (first-phase fault/delay
+    # identity + the digest chains) and the off/full arms consume that
+    # same pool — packing per-arm would compare different computations.
+    memo_runner = None
+    if args.memo != "off":
+        memo_runner = BatchedRunner(spec, cfg,
+                                    make_fast_delay(args.delay, 17),
+                                    batch=args.batch,
+                                    scheduler=args.scheduler,
+                                    exact_impl=args.exact_impl,
+                                    megatick=args.megatick,
+                                    queue_engine=args.queue_engine,
+                                    kernel_engine=args.kernel_engine,
+                                    fused_tick=args.fused_tick,
+                                    fused_block_edges=args.fused_block_edges,
+                                    fused_tile=args.fused_tile,
+                                    trace=trace, memo=args.memo)
+    packer = memo_runner if memo_runner is not None else runner
+    pool = packer.pack_jobs(jobs,
                             content_keys=True if args.memo != "off" else None)
     log(f"stream: {jcount} jobs over {args.batch} slots, pooled phase "
         f"table {pool.do_tick.shape[0]} rows, stretch={args.stretch}, "
         f"drain_chunk={args.drain_chunk}, dup_rate={args.dup_rate}, "
-        f"memo={args.memo}")
+        f"prefix_overlap={args.prefix_overlap}, memo={args.memo}")
 
     def drive(admission):
         t0 = _time.perf_counter()
@@ -988,21 +1017,9 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
     result["cost_model"] = tick_cost_model(
         runner.topo.n, runner.topo.e, cfg, batch=args.batch,
         queue_engine=runner.queue_engine)
-    if args.memo != "off":
+    if memo_runner is not None:
         # memo arm: same pool, same knobs, memo plane on — the headline is
         # effective jobs SERVED per second vs the memo-off arm above
-        memo_runner = BatchedRunner(spec, cfg,
-                                    make_fast_delay(args.delay, 17),
-                                    batch=args.batch,
-                                    scheduler=args.scheduler,
-                                    exact_impl=args.exact_impl,
-                                    megatick=args.megatick,
-                                    queue_engine=args.queue_engine,
-                                    kernel_engine=args.kernel_engine,
-                                    fused_tick=args.fused_tick,
-                                    fused_block_edges=args.fused_block_edges,
-                                    fused_tile=args.fused_tile,
-                                    trace=trace, memo=args.memo)
 
         def drive_memo():
             t0 = _time.perf_counter()
@@ -1039,6 +1056,59 @@ def run_stream_worker(args, dev, spec, cfg) -> int:
             "memo_hit_rate": sm["memo_hit_rate"],
             "memo_steps": sm["steps"],
         })
+        if args.memo == "prefix":
+            # the fork plane's acceptance denominator: an exact-match
+            # memo=full arm on the SAME pool. At dup_rate 0 it can
+            # coalesce nothing, so prefix_speedup isolates what forking
+            # from cached prefixes buys over the best exact-match plane.
+            full_runner = BatchedRunner(
+                spec, cfg, make_fast_delay(args.delay, 17),
+                batch=args.batch, scheduler=args.scheduler,
+                exact_impl=args.exact_impl, megatick=args.megatick,
+                queue_engine=args.queue_engine,
+                kernel_engine=args.kernel_engine,
+                fused_tick=args.fused_tick,
+                fused_block_edges=args.fused_block_edges,
+                fused_tile=args.fused_tile, trace=trace, memo="full")
+
+            def drive_full():
+                t0 = _time.perf_counter()
+                state, stream = full_runner.run_stream(
+                    pool, stretch=args.stretch,
+                    drain_chunk=args.drain_chunk)
+                jax.block_until_ready(state)
+                return _time.perf_counter() - t0, state, stream
+
+            dt_fw, _, stream_fw = drive_full()
+            served_f = len(full_runner.stream_results(stream_fw))
+            log(f"full-arm warmup: {dt_fw:.1f}s, served "
+                f"{served_f}/{jcount}")
+            if served_f != jcount:
+                log("ERROR: memo=full arm did not serve every job")
+                return 1
+            ftimes = []
+            for r in range(args.repeats):
+                dt, _, _ = drive_full()
+                ftimes.append(dt)
+                log(f"full run {r}: {dt:.3f}s -> {served_f / dt:.1f} "
+                    f"effective jobs/s")
+            eff_full = served_f / min(ftimes)
+            hist: dict = {}
+            for d in getattr(memo_runner, "_fork_depths", []):
+                hist[str(int(d))] = hist.get(str(int(d)), 0) + 1
+            result.update({
+                "prefix_overlap": args.prefix_overlap,
+                "prefix_hits": sm["prefix_hits"],
+                "forked_jobs": sm["forked_jobs"],
+                "fork_depth_mean": sm["fork_depth_mean"],
+                "fork_depth_hist": hist,
+                "prefix_evictions": sm["prefix_evictions"],
+                "effective_jobs_per_sec_full": round(eff_full, 2),
+                # the ISSUE-20 acceptance number: fork-served throughput
+                # as a multiple of exact-match memo on the same queue
+                "prefix_speedup": round(eff_memo / eff_full, 3)
+                if eff_full else 0.0,
+            })
     if trace is not None:
         from chandy_lamport_tpu.utils.tracing import trace_counts
 

@@ -366,13 +366,15 @@ def _cmd_stream(args) -> int:
                            fused_tick=args.fused_tick,
                            faults=faults, quarantine=faults is not None,
                            trace=trace, memo=args.memo,
-                           memo_cache=args.memo_cache, guards=guards)
+                           memo_cache=args.memo_cache,
+                           prefix_cache=args.prefix_cache, guards=guards)
     jcount = args.jobs or 3 * args.batch
     jobs = stream_jobs(spec, jcount, seed=args.seed,
                        base_phases=args.base_phases,
                        tail_alpha=args.tail_alpha,
                        max_phases=args.max_phases,
-                       dup_rate=args.dup_rate)
+                       dup_rate=args.dup_rate,
+                       prefix_overlap=args.prefix_overlap)
     pool = runner.pack_jobs(jobs)
     state = stream = None
     if args.resume_from:
@@ -403,6 +405,7 @@ def _cmd_stream(args) -> int:
                 "batch": args.batch, "jobs": jcount,
                 "admission": args.admission, "scheduler": args.scheduler,
                 "memo": runner.memo, "dup_rate": args.dup_rate,
+                "prefix_overlap": args.prefix_overlap,
                 "wall_seconds": round(wall, 3),
                 "jobs_per_sec": round(done / wall, 2) if wall > 0 else 0.0,
                 # jobs SERVED per second: executed + memo-served — the
@@ -765,18 +768,31 @@ def main(argv=None) -> int:
                          "scenario-library job byte-for-byte "
                          "(models/workloads.stream_jobs) — the traffic "
                          "shape the memo plane serves for free")
-    pq.add_argument("--memo", choices=["off", "admit", "full"],
+    pq.add_argument("--memo", choices=["off", "admit", "full", "prefix"],
                     default="off",
                     help="memo plane (config.ENGINE_KNOBS): 'admit' "
                          "coalesces duplicate jobs onto one lane + serves "
                          "persistent-cache hits; 'full' adds transition "
-                         "fast-forwarding. 'off' is bit-identical to the "
+                         "fast-forwarding; 'prefix' adds speculative "
+                         "forking of near-duplicates from checkpointed "
+                         "prefix boundaries. 'off' is bit-identical to the "
                          "pre-memo engine; every served summary is "
                          "bit-identical to solo execution")
     pq.add_argument("--memo-cache", metavar="PATH",
                     help="persistent content-addressed summary cache "
                          "(JSON lines; utils/memocache.py) — hits across "
                          "runs are served without burning a lane")
+    pq.add_argument("--prefix-overlap", type=float, default=0.0,
+                    metavar="R",
+                    help="fraction of the queue that extends a shared base "
+                         "scenario with a unique tail — NEAR-duplicates "
+                         "(models/workloads.stream_jobs prefix_overlap), "
+                         "the traffic shape memo=prefix forks for free")
+    pq.add_argument("--prefix-cache", metavar="PATH",
+                    help="persistent prefix-checkpoint store for "
+                         "memo=prefix (JSON lines; utils/memocache."
+                         "PrefixCache) — forks across runs resume from "
+                         "the deepest checkpointed boundary on disk")
     pq.add_argument("--snapshots", type=int, default=8)
     pq.add_argument("--scheduler", choices=["sync", "exact"], default="sync")
     pq.add_argument("--kernel-engine", choices=["auto", "xla", "pallas"],

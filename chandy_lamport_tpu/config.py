@@ -38,10 +38,16 @@ ENGINE_KNOBS = {
     # duplicates coalesce onto one representative lane and the
     # persistent summary cache serves repeats without burning a lane;
     # "full" adds transition fast-forwarding over the per-lane state
-    # signature. Spellings are ordered weakest-first, not "auto"-first:
-    # there is no backend-dependent resolution, only an explicit
-    # opt-in ladder.
-    "memo": ("off", "admit", "full"),
+    # signature; "prefix" layers rolling per-phase-boundary digests on
+    # the admit plane — near-duplicate jobs (shared script prefix,
+    # divergent tail) fork from a checkpointed lane state at the deepest
+    # cached prefix boundary instead of running the prefix cold
+    # (utils/memocache.PrefixCache). Spellings are ordered
+    # weakest-first, not "auto"-first: there is no backend-dependent
+    # resolution, only an explicit opt-in ladder ("prefix" sits beside
+    # "full", not above it — it trades the sig fast-forward for the
+    # fork plane).
+    "memo": ("off", "admit", "full", "prefix"),
     # serving admission policy (serving/admission.resolve_serve_policy):
     # "edf" (default) orders the eligible queue by priority class then
     # earliest deadline first; "fifo" is the arrival-order baseline the

@@ -229,6 +229,9 @@ CHECKPOINT_FORMAT_HISTORY: Tuple[Tuple[int, str], ...] = (
     (9, "serving-plane StreamState leaves (deadline_misses + per-tenant "
         "tenant_served/tenant_quota books): a killed serve run resumes "
         "its deadline-miss and fairness accounting bit-exactly"),
+    (10, "prefix-fork StreamState counters (prefix_hits/forked_jobs/"
+         "fork_depth_sum): a killed memo=\"prefix\" run resumes its "
+         "speculative-fork accounting bit-exactly"),
 )
 CHECKPOINT_FORMAT_VERSION = CHECKPOINT_FORMAT_HISTORY[-1][0]
 

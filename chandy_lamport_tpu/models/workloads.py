@@ -87,7 +87,8 @@ def stream_jobs(spec: TopologySpec, count: int, seed: int,
                 base_phases: int = 4, tail_alpha: float = 1.1,
                 max_phases: int = 64, amount: int = 1,
                 snapshots_per_job: int = 1,
-                dup_rate: float = 0.0) -> List[List[Event]]:
+                dup_rate: float = 0.0,
+                prefix_overlap: float = 0.0) -> List[List[Event]]:
     """A heavy-tailed job mix for the streaming engine
     (parallel/batch.run_stream): ``count`` event-list jobs whose phase
     counts follow a Pareto(``tail_alpha``) tail over ``base_phases``
@@ -110,11 +111,51 @@ def stream_jobs(spec: TopologySpec, count: int, seed: int,
     first; each repeat slot then draws a library index Zipf-style
     (weight 1/(k+1), so early scenarios dominate — the hot-set shape)
     and the draws are shuffled in among the originals. dup_rate 0 (the
-    default) reproduces the historical all-unique mix bit-for-bit."""
+    default) reproduces the historical all-unique mix bit-for-bit.
+
+    ``prefix_overlap``: the NEAR-duplicate traffic shape (memo="prefix"
+    plane) — every one of the ``count`` jobs copies a base scenario from
+    a library of ``max(1, round(count * (1 - prefix_overlap)))``
+    verbatim (Zipf-drawn, hot bases dominate) and appends one unique
+    closing tail (a single-token send over the job's own link plus a
+    tick run whose length encodes the job index — never more than one
+    token moves, so no balance can underflow — making every job's
+    whole-script digest distinct: dup_rate is exactly 0 and plain memo
+    coalescing can serve NOTHING), which means jobs
+    drawing the same base share its full phase-boundary digest chain
+    and only diverge at the last phase. Mutually exclusive with
+    ``dup_rate``; both are separate axes of the same library idea."""
     if count < 1:
         raise ValueError("count must be >= 1")
     if not 0.0 <= dup_rate < 1.0:
         raise ValueError("dup_rate must be in [0, 1)")
+    if not 0.0 <= prefix_overlap < 1.0:
+        raise ValueError("prefix_overlap must be in [0, 1)")
+    if prefix_overlap:
+        if dup_rate:
+            raise ValueError(
+                "prefix_overlap and dup_rate are mutually exclusive "
+                "traffic shapes — arm one")
+        nbase = max(1, round(count * (1.0 - prefix_overlap)))
+        library = stream_jobs(spec, nbase, seed, base_phases=base_phases,
+                              tail_alpha=tail_alpha, max_phases=max_phases,
+                              amount=amount,
+                              snapshots_per_job=snapshots_per_job)
+        rng = random.Random(seed + 0x9EF1)
+        weights = [1.0 / (k + 1) for k in range(nbase)]
+        picks = rng.choices(range(nbase), weights=weights, k=count)
+        links = list(spec.links)
+        out: List[List[Event]] = []
+        for i, k in enumerate(picks):
+            # uniqueness comes from the (link, tick-run) PAIR, not the
+            # token amount: amount+i sends would drain a node's balance
+            # below zero once count outgrows the initial funding
+            src, dest = links[i % len(links)]
+            out.append(list(library[k])
+                       + [PassTokenEvent(src=src, dest=dest,
+                                         tokens=amount),
+                          TickEvent(1 + i // len(links))])
+        return out
     if dup_rate:
         nuniq = max(1, round(count * (1.0 - dup_rate)))
         library = stream_jobs(spec, nuniq, seed, base_phases=base_phases,

@@ -2744,3 +2744,35 @@ def reset_lanes(state: DenseState, mask, topo: DenseTopology,
 
     out = jax.tree_util.tree_map(mix, flat, fresh)
     return out._replace(**keep)
+
+
+def fork_lanes(state: DenseState, mask, bank: DenseState,
+               src) -> DenseState:
+    """Scatter checkpointed prefix states into admitted lanes: lane b
+    where ``mask`` [B] is True takes every SIMULATION leaf from bank row
+    ``src[b]`` (``bank`` is a DenseState with an [F] lead axis — the
+    decoded prefix-checkpoint bank), so the lane resumes from the phase
+    boundary the checkpoint captured instead of from reset_lanes' fresh
+    template. The dual of reset_lanes' keep-set shrinks by one:
+    ``delay_state`` IS forked (the sampler's counters advanced during
+    the prefix — scattering the pool's fresh row would replay the
+    prefix's delay draws in the tail), while ``fault_key`` stays from
+    admission (it is part of the prefix digest, so pool row == bank row
+    by construction and the admitted value is already right). The
+    job_id/prog_cursor/admit_tick and flight-recorder leaves stay lane
+    bookkeeping exactly as in reset_lanes; the admission step aims
+    prog_cursor past the forked prefix itself."""
+    keep = ("fault_key", "job_id", "prog_cursor", "admit_tick",
+            "tr_meta", "tr_data", "tr_tick", "tr_count", "tr_on")
+    srci = jnp.asarray(src, jnp.int32)
+
+    def mix(old, row):
+        old = jnp.asarray(old)
+        m = jnp.reshape(mask, mask.shape + (1,) * (old.ndim - mask.ndim))
+        return jnp.where(m, jnp.asarray(row)[srci], old)
+
+    updates = {
+        name: jax.tree_util.tree_map(
+            mix, getattr(state, name), getattr(bank, name))
+        for name in state._fields if name not in keep}
+    return state._replace(**updates)

@@ -43,6 +43,7 @@ from chandy_lamport_tpu.core.state import (
 from chandy_lamport_tpu.ops.delay_jax import JaxDelay
 from chandy_lamport_tpu.ops.tick import (
     TickKernel,
+    fork_lanes,
     harvest_lane_summaries,
     reset_lanes,
 )
@@ -54,8 +55,12 @@ from chandy_lamport_tpu.utils.guards import (
 )
 from chandy_lamport_tpu.utils.memocache import (
     MemoCacheError,
+    PrefixCache,
+    PrefixCacheError,
     SummaryCache,
     job_digest,
+    prefix_extend,
+    prefix_seed_digest,
     resolve_memo,
 )
 from chandy_lamport_tpu.utils.tracing import (
@@ -63,6 +68,7 @@ from chandy_lamport_tpu.utils.tracing import (
     EV_LANE_COALESCE,
     EV_LANE_HARVEST,
     EV_MEMO_HIT,
+    EV_PREFIX_FORK,
     EV_SERVE_ADMIT,
     EV_SERVE_MISS,
     JaxTrace,
@@ -86,8 +92,25 @@ OP_NOP, OP_SEND, OP_SNAPSHOT = 0, 1, 2
 # against the served one — a standing audit that memoized answers stay
 # exact (a mismatch raises MemoCacheError naming the digest).
 # run_stream(shadow_every=...) overrides it (0 disables; tests tighten it
-# to 1 for full coverage).
+# to 1 for full coverage). With memo == "prefix" the same cadence ALSO
+# audits forked jobs: every shadow_every-th fork admission re-runs its
+# job cold in a solo side-stream at finalize and byte-compares the
+# summaries (a prefix checkpoint that drifted from cold execution raises
+# PrefixCacheError naming the digest and depth).
 MEMO_SHADOW_EVERY = 16
+
+# DenseState leaves a prefix checkpoint does NOT capture — fork_lanes'
+# keep-set (ops/tick): lane bookkeeping (job_id/prog_cursor/admit_tick),
+# the per-lane flight-recorder ring (a LANE artifact spanning
+# admissions), and fault_key (part of the chain identity, so the
+# admitted pool row already equals the producer's). Everything else —
+# time, tokens, both queue planes, snapshot/supervisor books,
+# delay-sampler state (its counters ADVANCED during the prefix), fault
+# books, sig, error — is captured byte-losslessly, so a forked lane is
+# bit-identical to a cold lane whose cursor just crossed the boundary.
+_PREFIX_KEEP_LEAVES = frozenset((
+    "fault_key", "job_id", "prog_cursor", "admit_tick",
+    "tr_meta", "tr_data", "tr_tick", "tr_count", "tr_on"))
 
 # DenseState leaves EXCLUDED from the per-lane state signature: ``time``
 # deliberately (fast-forwarding asks "is this state invariant under the
@@ -275,7 +298,17 @@ class JobPool(NamedTuple):
     over topology + script + stream identities + resolved knobs + config)
     as raw sha256 bytes — all-zero rows when the runner's memo plane is
     off (pack_jobs computes digests only under ``content_keys``, where
-    duplicate scripts share stream identities and therefore digests)."""
+    duplicate scripts share stream identities and therefore digests).
+
+    ``prefix_digest`` (memo="prefix" only, else None) is the rolling
+    prefix-digest CHAIN, row-aligned with the pooled phase table:
+    ``prefix_digest[job_start[j] + i]`` = sha256 chain link over job j's
+    script-free identity (topology + fault/delay row + resolved knobs +
+    config — utils/memocache.prefix_seed_digest) extended by its first
+    i+1 pooled phase rows (prefix_extend). Two jobs share link d iff
+    they share identity AND their first d compiled phases — the content
+    address of "the lane state at phase boundary d". Host-side planning
+    data only; never shipped to the device."""
 
     kind: Any        # i32 [P, K]  pooled phase ops (batch.compile_events)
     arg0: Any        # i32 [P, K]
@@ -287,6 +320,7 @@ class JobPool(NamedTuple):
     fault_key: Any   # u32 [J]     per-job adversary key (0 = disarmed)
     digest: Any      # u8 [J, 32]  sha256 content address (0s when memo off)
     delay_state: Any  # pytree, leaves [J, ...]: per-job delay stream rows
+    prefix_digest: Any = None  # u8 [P, 32] phase-boundary chain (prefix mode)
 
     @property
     def num_jobs(self) -> int:
@@ -319,6 +353,15 @@ class StreamState(NamedTuple):
     coalesced_jobs: Any    # i32 []  duplicate jobs served by a rep lane
     ff_skipped_ticks: Any  # i32 []  ticks credited by fast-forward
     shadow_checks: Any     # i32 []  served summaries re-proven by shadow
+    # prefix-fork books (checkpoint format v10, memo="prefix"):
+    # forked_jobs/fork_depth_sum accumulate ON-DEVICE at admission (the
+    # fork scatter counts itself), so a kill mid-stream resumes the fork
+    # accounting bit-exactly; prefix_hits is host-stamped at finalize
+    # from the admission plan (the planned forks — equal to forked_jobs
+    # on a completed run, the books-balance invariant chaos_smoke pins)
+    prefix_hits: Any       # i32 []  jobs planned to fork from a checkpoint
+    forked_jobs: Any       # i32 []  fork admissions the device performed
+    fork_depth_sum: Any    # i32 []  total phases skipped by forks
     # serving-plane books (checkpoint format v9): deadline_misses and
     # tenant_served accumulate on-device at harvest in the serve step
     # (serving/server.py); tenant_quota is the admission cap the server
@@ -358,6 +401,9 @@ class BatchedRunner:
                  quarantine: bool = False, trace=None,
                  memo: str = "off", memo_cache: Optional[str] = None,
                  memo_cache_entries: int = 0, memo_cache_bytes: int = 0,
+                 prefix_cache: Optional[str] = None,
+                 prefix_cache_entries: int = 0,
+                 prefix_cache_bytes: int = 0,
                  guards=None, fused_tick: Optional[str] = None,
                  fused_block_edges: int = 0,
                  fused_tile: Optional[str] = None):
@@ -485,6 +531,24 @@ class BatchedRunner:
         ``memo_cache_bytes``: LRU capacity bounds for that cache
         (SummaryCache docstring; 0 = unbounded).
 
+        memo == "prefix" layers the fork plane on the admit contract:
+        pack_jobs additionally derives each job's stream identity from
+        its FIRST compiled phase row (so near-duplicates sharing a
+        prefix share fault/delay streams — exact duplicates still share
+        full digests and coalesce) and stamps the rolling
+        phase-boundary digest chain; run_stream checkpoints hot
+        boundaries (shared in-pool, or previously seen in the
+        PrefixCache) via a produce pass and admits chain-sharing jobs
+        by FORKING the checkpointed lane state at the divergence cursor
+        (ops/tick.fork_lanes; EV_PREFIX_FORK traced; rows carry
+        ``served_from="prefix:<depth>"``). ``prefix_cache``: path of
+        the persistent checkpoint store (memocache.PrefixCache; None
+        keeps it in-memory on the runner, persisting across run_stream
+        calls in-process). ``prefix_cache_entries``/
+        ``prefix_cache_bytes``: its LRU bounds (0 = unbounded; bytes
+        is the one that matters — checkpoints are KBs, not the
+        SummaryCache's ~200 B rows).
+
         guards: utils/guards.RuntimeGuards — opt-in runtime contract
         sentry. When set, ``run_stream`` arms transfer_guard/leak
         checking/the compile counter around its steady-state device
@@ -502,6 +566,17 @@ class BatchedRunner:
         # bounded LRU satellite): summarize_stream surfaces them
         self._memo_cache_stats = {"cache_evictions": 0,
                                   "cache_evicted_bytes": 0}
+        # prefix plane (memo="prefix"): checkpoint store config + the
+        # most recent run's fork books (summarize_stream surfaces them)
+        self.prefix_cache_path = prefix_cache
+        self.prefix_cache_entries = int(prefix_cache_entries)
+        self.prefix_cache_bytes = int(prefix_cache_bytes)
+        self._prefix_cache_handle: Optional[PrefixCache] = None
+        self._prefix_stats = {"prefix_evictions": 0,
+                              "prefix_evicted_bytes": 0,
+                              "prefix_store_entries": 0}
+        self._fork_depths: List[int] = []
+        self._produce_jits: dict = {}
         # per-run rows served without execution (job -> result row);
         # stream_results merges them with the harvested ring
         self._memo_rows: dict = {}
@@ -1049,25 +1124,52 @@ class BatchedRunner:
         else:
             u_index = np.arange(jcount)
             nuniq = jcount
+        if content_keys and self.memo == "prefix":
+            # prefix identity rank: first-appearance index of each
+            # distinct FIRST pooled phase row. Full-script rank would
+            # hand jobs that differ only in their tails distinct
+            # fault/delay streams, making every prefix checkpoint
+            # single-use; keying the stream identity on phase 0 makes
+            # chain-sharing jobs share streams (so a checkpoint forks
+            # into all of them) while exact duplicates — same first
+            # row a fortiori — still share full digests and coalesce.
+            # Identity stays content-derived, so summaries remain pure
+            # functions of job content (the fleet bit-identity bar).
+            f_of: dict = {}
+            ident_index = np.zeros(jcount, np.int64)
+            for j in range(jcount):
+                r = int(start[j])
+                fsig = (kind[r].tobytes(), arg0[r].tobytes(),
+                        arg1[r].tobytes(), int(do_tick[r]))
+                ident_index[j] = f_of.setdefault(fsig, len(f_of))
+            nident = len(f_of)
+        else:
+            ident_index, nident = u_index, nuniq
         if self.faults is not None:
-            keys = np.asarray(self.faults.init_batch_state(nuniq))[u_index]
+            keys = np.asarray(
+                self.faults.init_batch_state(nident))[ident_index]
             if fault_armed is not None:
                 keys = np.where(np.asarray(fault_armed, bool), keys,
                                 keys.dtype.type(0))
         else:
             keys = np.zeros(jcount, np.uint32)
+        prefix_digest = None
         if content_keys:
             delay_rows = jax.tree_util.tree_map(
-                lambda x: np.asarray(x)[u_index],
-                self.delay.init_batch_state(nuniq))
+                lambda x: np.asarray(x)[ident_index],
+                self.delay.init_batch_state(nident))
             digests = self._job_digests(scripts, u_index, keys, delay_rows)
+            if self.memo == "prefix":
+                prefix_digest = self._prefix_chains(
+                    kind, arg0, arg1, do_tick, start, end, ident_index,
+                    keys, delay_rows)
         else:
             # the pre-memo path, untouched: index-derived rows handed to
             # the pool as built (stream-vs-static parity depends on it)
             delay_rows = self.delay.init_batch_state(jcount)
             digests = np.zeros((jcount, 32), np.uint8)
         return JobPool(kind, arg0, arg1, do_tick, start, end, limit, keys,
-                       digests, delay_rows)
+                       digests, delay_rows, prefix_digest)
 
     def _job_digests(self, scripts, u_index, keys, delay_rows) -> np.ndarray:
         """[J, 32] sha256 content addresses (utils/memocache.job_digest):
@@ -1075,25 +1177,7 @@ class BatchedRunner:
         its compiled script, its fault/delay stream rows, and the runner's
         resolved execution identity (scheduler, engines, semantic config).
         Duplicate (script, fault key) pairs hash once."""
-        import dataclasses
-
-        cfg_fields = dataclasses.asdict(self.config)
-        # trace_capacity changes only observability (the flight-recorder
-        # ring), never a summary — the one excluded field
-        cfg_fields.pop("trace_capacity")
-        knobs = {
-            "queue_engine": self.queue_engine,
-            "kernel_engine": self.kernel_engine,
-            "fused_tick": self.fused,
-            "fused_tile": self.fused_tile,
-            "exact_impl": self.kernel.exact_impl,
-            "megatick": self.megatick,
-            "check_every": self.check_every,
-            "quarantine": self.quarantine,
-            "delay_kind": type(self.delay).__name__,
-            "faults": (None if self.faults is None
-                       else sorted(vars(self.faults).items())),
-        }
+        cfg_fields, knobs = self._digest_identity()
         leaves, treedef = jax.tree_util.tree_flatten(
             jax.device_get(delay_rows))
         leaves = [np.asarray(x) for x in leaves]
@@ -1115,6 +1199,66 @@ class BatchedRunner:
                     config_fields=cfg_fields)
                 seen[memo_key] = hx
             out[j] = np.frombuffer(bytes.fromhex(hx), np.uint8)
+        return out
+
+    def _digest_identity(self):
+        """The runner's execution identity as digest ingredients: the
+        semantics-affecting SimConfig fields and the RESOLVED engine
+        knobs — shared by the whole-job digest (_job_digests) and the
+        prefix-chain seed (_prefix_chains), so the two planes can never
+        drift on what "same computation" means."""
+        import dataclasses
+
+        cfg_fields = dataclasses.asdict(self.config)
+        # trace_capacity changes only observability (the flight-recorder
+        # ring), never a summary — the one excluded field
+        cfg_fields.pop("trace_capacity")
+        knobs = {
+            "queue_engine": self.queue_engine,
+            "kernel_engine": self.kernel_engine,
+            "fused_tick": self.fused,
+            "fused_tile": self.fused_tile,
+            "exact_impl": self.kernel.exact_impl,
+            "megatick": self.megatick,
+            "check_every": self.check_every,
+            "quarantine": self.quarantine,
+            "delay_kind": type(self.delay).__name__,
+            "faults": (None if self.faults is None
+                       else sorted(vars(self.faults).items())),
+        }
+        return cfg_fields, knobs
+
+    def _prefix_chains(self, kind, arg0, arg1, do_tick, start, end,
+                       ident_index, keys, delay_rows) -> np.ndarray:
+        """[P, 32] rolling phase-boundary digest chains over the pooled
+        phase table (JobPool.prefix_digest docstring): per job, link 0
+        is the script-free identity seed (prefix_seed_digest over the
+        same ingredients as job_digest minus the script) and each pooled
+        phase row extends it (prefix_extend), written at its row. Seeds
+        dedup by (identity rank, armed key) — chain-sharing jobs share
+        seeds by construction."""
+        cfg_fields, knobs = self._digest_identity()
+        leaves, treedef = jax.tree_util.tree_flatten(
+            jax.device_get(delay_rows))
+        leaves = [np.asarray(x) for x in leaves]
+        out = np.zeros((kind.shape[0], 32), np.uint8)
+        seeds: dict = {}
+        for j in range(len(start)):
+            seed_key = (int(ident_index[j]), int(keys[j]))
+            c = seeds.get(seed_key)
+            if c is None:
+                c = prefix_seed_digest(
+                    topo_spec=self._topo_spec,
+                    fault_key=int(keys[j]),
+                    delay_row={"treedef": str(treedef),
+                               "leaves": [lv[j] for lv in leaves]},
+                    scheduler=self.scheduler, knobs=knobs,
+                    config_fields=cfg_fields)
+                seeds[seed_key] = c
+            for r in range(int(start[j]), int(end[j])):
+                c = prefix_extend(
+                    c, (kind[r], arg0[r], arg1[r], int(do_tick[r])))
+                out[r] = np.frombuffer(c, np.uint8)
         return out
 
     def init_stream(self, pool: JobPool,
@@ -1145,7 +1289,8 @@ class BatchedRunner:
             next_job=i(0), jobs_done=i(0), steps=i(0), refills=i(0),
             lane_steps_live=i(0), lane_steps_total=i(0),
             cache_hits=i(0), coalesced_jobs=i(0), ff_skipped_ticks=i(0),
-            shadow_checks=i(0), deadline_misses=i(0),
+            shadow_checks=i(0), prefix_hits=i(0), forked_jobs=i(0),
+            fork_depth_sum=i(0), deadline_misses=i(0),
             tenant_served=z(t), tenant_quota=quota, res_count=i(0),
             res_job=np.full(r, -1, np.int32), res_time=z(r), res_error=z(r),
             res_snap_started=z(r), res_snap_completed=z(r),
@@ -1153,11 +1298,19 @@ class BatchedRunner:
             res_admit_step=z(r), res_tokens=z(r, self.topo.n))
 
     def _stream_step(self, stretch: int, drain_chunk: int, gang: bool,
-                     serve: bool = False):
+                     serve: bool = False, memo: Optional[str] = None):
         if not hasattr(self, "_stream_jits"):
             self._stream_jits = {}
+        if memo is None:
+            # serve handles coalescing host-side, so its step compiles
+            # the memo-off admission — EXCEPT under "prefix", whose fork
+            # scatter must live inside the jitted admission. An explicit
+            # ``memo`` overrides (the cold solo side-runs of the fork
+            # shadow audit compile the off step on a memoized runner).
+            memo = (self.memo if (not serve or self.memo == "prefix")
+                    else "off")
         key = (int(stretch), int(drain_chunk), bool(gang),
-               "off" if serve else self.memo, bool(serve))
+               memo, bool(serve))
         fn = self._stream_jits.get(key)
         if fn is None:
             fn = jax.jit(self._build_stream_step(*key),
@@ -1275,7 +1428,8 @@ class BatchedRunner:
 
         def step(state, stream, pool, order=None, followers=None,
                  limit=None, tenant_of=None, arrival_of=None,
-                 deadline_of=None):
+                 deadline_of=None, bank=None, fork_src=None,
+                 fork_depth=None):
             jcount = pool.job_start.shape[0]
             jmax = jcount - 1
             rcap = stream.res_job.shape[0]
@@ -1401,6 +1555,36 @@ class BatchedRunner:
                                                 state.prog_cursor)),
                 admit_tick=jnp.where(admit, stream.steps,
                                      jnp.where(reset, 0, state.admit_tick)))
+            if memo == "prefix":
+                # speculative fork: an admitted lane whose exec position
+                # the host plan mapped to a checkpoint bank row takes
+                # the checkpointed state (fork_lanes overwrites every
+                # semantic leaf INCLUDING delay_state — pick() just
+                # copied the pool's fresh row, which would replay the
+                # prefix's delay draws) and resumes at the divergence
+                # cursor. fork_src is JOB-indexed (-1 = cold admission;
+                # the duplicate-shadow jobs stay -1 by construction), so
+                # a serving host re-sorting its un-admitted exec-order
+                # suffix never invalidates the fork plan.
+                fmax = bank.time.shape[0] - 1
+                fsrc = fork_src[new_jidc]
+                fdep = fork_depth[new_jidc]
+                is_fork = admit & (fsrc >= 0)
+                state = fork_lanes(state, is_fork, bank,
+                                   jnp.clip(fsrc, 0, fmax))
+                state = state._replace(
+                    prog_cursor=jnp.where(
+                        is_fork, pool.job_start[new_jidc] + fdep,
+                        state.prog_cursor))
+                stream = stream._replace(
+                    forked_jobs=stream.forked_jobs
+                    + jnp.sum(is_fork, dtype=jnp.int32),
+                    fork_depth_sum=stream.fork_depth_sum
+                    + jnp.sum(jnp.where(is_fork, fdep, 0),
+                              dtype=jnp.int32))
+                if self._trace_on:
+                    state = trace_append_lanes(state, is_fork,
+                                               EV_PREFIX_FORK, fdep)
             if self._trace_on:
                 state = trace_append_lanes(state, admit, EV_LANE_ADMIT,
                                            new_jid)
@@ -1411,7 +1595,7 @@ class BatchedRunner:
                 state = trace_append_lanes(
                     state, admit, EV_SERVE_ADMIT,
                     jnp.maximum(stream.steps - arrival_of[new_jidc], 0))
-            if self._trace_on and memo != "off":
+            if self._trace_on and memo != "off" and followers is not None:
                 fcnt = followers[epos]
                 state = trace_append_lanes(state, admit & (fcnt > 0),
                                            EV_LANE_COALESCE, fcnt)
@@ -1636,6 +1820,317 @@ class BatchedRunner:
                                  shadow_checks=np.int32(nshadow))
         return state, stream
 
+    # -- memo="prefix": speculative fork from checkpointed prefixes -------
+
+    def _prefix_cache(self) -> PrefixCache:
+        """The prefix-checkpoint store this run plans against. File-backed
+        (``prefix_cache`` knob): a FRESH handle per run, so checkpoints
+        other processes flushed are visible to the next plan. No file:
+        one persistent in-memory handle per runner — repeats of the same
+        pool (bench warmup -> timed reps) fork from the checkpoints the
+        first run produced."""
+        if self.prefix_cache_path is not None:
+            return PrefixCache(self.prefix_cache_path,
+                               max_entries=self.prefix_cache_entries,
+                               max_bytes=self.prefix_cache_bytes)
+        if self._prefix_cache_handle is None:
+            self._prefix_cache_handle = PrefixCache(
+                None, max_entries=self.prefix_cache_entries,
+                max_bytes=self.prefix_cache_bytes)
+        return self._prefix_cache_handle
+
+    def _prefix_produce_step(self, nsub: int):
+        """Jitted prefix producer: vmapped cold replay of each lane's
+        script rows up to a per-lane stop cursor — the streaming step's
+        script stage verbatim (same pooled-row addressing, same
+        _apply_phase composition), with the stage test replaced by the
+        stop cursor because a prefix never enters its drain. Keyed by
+        scan length; _prefix_produce rounds chunks up to the next power
+        of two, bounding compiles to O(log max prefix depth)."""
+        fn = self._produce_jits.get(nsub)
+        if fn is None:
+            def body(s, pool, stop):
+                def script(u):
+                    c = jnp.clip(u.prog_cursor, 0,
+                                 pool.kind.shape[0] - 1)
+                    ops = (pool.kind[c], pool.arg0[c], pool.arg1[c],
+                           pool.do_tick[c])
+                    u = self._apply_phase(u, ops)
+                    return u._replace(prog_cursor=u.prog_cursor + 1)
+
+                def sub(u, _):
+                    return lax.cond(u.prog_cursor < stop, script,
+                                    lambda v: v, u), None
+
+                s, _ = lax.scan(sub, s, None, length=nsub)
+                return s
+
+            fn = jax.jit(jax.vmap(body, in_axes=(0, None, 0)),
+                         donate_argnums=0)
+            self._produce_jits[nsub] = fn
+        return fn
+
+    def _prefix_produce(self, pool: JobPool, pool_dev, cands,
+                        pcache: PrefixCache) -> None:
+        """Run every candidate prefix cold (one producer dispatch per
+        B-sized chunk) and checkpoint the boundary states. A producer
+        lane is EXACTLY a streaming lane at admission — fresh init
+        template + the job's pooled identity rows (fault_key + delay
+        row) + the job's start cursor — so the captured state is
+        bit-identical to what a cold stream lane holds at the boundary
+        cursor. Captured leaves: every DenseState field outside
+        _PREFIX_KEEP_LEAVES (the admission-owned identity/trace leaves
+        fork_lanes preserves), with the delay pytree flattened row-wise
+        (the fork bank rebuilds it with the template treedef).
+        ``cands``: (digest_hex, job, depth) triples."""
+        if not cands:
+            return
+        B = self.batch
+        starts = np.asarray(pool.job_start)
+        capture = [f for f in DenseState._fields
+                   if f not in _PREFIX_KEEP_LEAVES
+                   and f != "delay_state"]
+        for lo in range(0, len(cands), B):
+            chunk = cands[lo:lo + B]
+            pad = B - len(chunk)
+            idx = np.asarray([j for _, j, _ in chunk]
+                             + [chunk[-1][1]] * pad, np.int64)
+            deps = np.asarray([d for _, _, d in chunk] + [0] * pad,
+                              np.int32)
+            st = self.init_batch()
+            st = st._replace(
+                delay_state=jax.tree_util.tree_map(
+                    lambda p: np.ascontiguousarray(np.asarray(p)[idx]),
+                    pool.delay_state),
+                fault_key=np.asarray(pool.fault_key)[idx].astype(
+                    np.asarray(st.fault_key).dtype),
+                prog_cursor=starts[idx].astype(np.int32),
+                job_id=idx.astype(np.int32))
+            stops = (starts[idx] + deps).astype(np.int32)
+            nsub = 1 << (max(1, int(deps.max())) - 1).bit_length()
+            out = jax.device_get(self._prefix_produce_step(nsub)(
+                jax.tree_util.tree_map(jnp.asarray, st), pool_dev,
+                jnp.asarray(stops)))
+            ds_leaves = [np.asarray(x) for x in
+                         jax.tree_util.tree_leaves(out.delay_state)]
+            for i, (dg, _j, d) in enumerate(chunk):
+                leaves = {f: np.asarray(getattr(out, f))[i]
+                          for f in capture}
+                leaves["delay_state"] = tuple(x[i] for x in ds_leaves)
+                pcache.put_ckpt(dg, int(d), leaves)
+
+    def _prefix_plan(self, pool: JobPool, pool_dev, plan: dict,
+                     shadow_every: Optional[int]) -> dict:
+        """Host-side speculative-fork plan over _memo_plan's exec order:
+        for each executing leader, find the DEEPEST phase boundary whose
+        chain digest either already has a checkpoint (fork free) or is
+        hot enough to produce one now (>= 2 leaders cross it this run,
+        or a previous run bumped its seen counter); run the producer for
+        the chosen boundaries; decode every fork source through the
+        cache codec (in-run and cross-run forks share one decode path)
+        into a power-of-two bank; and stamp fork_src/fork_depth per JOB
+        (-1 = cold admission; _memo_plan's exact-duplicate shadows stay
+        cold by construction — they are follower job ids, never the
+        leader's — so the memo shadow audit also cross-checks forked
+        leaders). Every checkpoint-less boundary
+        walked gets its seen counter bumped, so the NEXT run — or the
+        next request on a serving fleet's shared cache — checkpoints
+        what this one only crossed.
+
+        Deterministic in (pool, plan, cache state at entry); and because
+        a fork is bit-exact, a cache file advanced by another writer
+        between a checkpoint save and its resume only changes WHERE
+        lanes fork, never what any job computes."""
+        if pool.prefix_digest is None:
+            raise ValueError(
+                "memo='prefix' needs a prefix-chained pool — pack_jobs on "
+                "the prefix runner (content_keys on) stamps the "
+                "phase-boundary digest chain")
+        chains = np.asarray(pool.prefix_digest)
+        starts = np.asarray(pool.job_start)
+        ends = np.asarray(pool.job_end)
+        pcache = self._prefix_cache()
+        exec_jobs = plan["exec"]
+        shadows = plan["shadows"]
+        leaders = [j for j in exec_jobs if j not in shadows]
+
+        def chex(j, d):
+            return bytes(bytearray(
+                chains[int(starts[j]) + d - 1].tolist())).hex()
+
+        counts: dict = {}
+        for j in leaders:
+            for d in range(1, int(ends[j] - starts[j]) + 1):
+                dg = chex(j, d)
+                counts[dg] = counts.get(dg, 0) + 1
+        fork_of: dict = {}    # leader job -> (digest_hex, depth)
+        produce: dict = {}    # digest_hex -> (job, depth) to produce
+        for j in leaders:
+            for d in range(int(ends[j] - starts[j]), 0, -1):
+                dg = chex(j, d)
+                if pcache.has_ckpt(dg) or dg in produce:
+                    fork_of[j] = (dg, d)
+                    break
+                if counts.get(dg, 0) >= 2 or pcache.seen(dg) >= 1:
+                    # the first leader through seeds the checkpoint and
+                    # forks from it itself — the prefix runs ONCE (in
+                    # the producer) either way, so this is never slower
+                    # than cold, and every later leader forks free
+                    produce[dg] = (j, d)
+                    fork_of[j] = (dg, d)
+                    break
+        bumped: set = set()
+        for j in leaders:
+            for d in range(1, int(ends[j] - starts[j]) + 1):
+                dg = chex(j, d)
+                if dg not in bumped and dg not in produce \
+                        and not pcache.has_ckpt(dg):
+                    bumped.add(dg)
+                    pcache.bump_seen(dg, d)
+        self._prefix_produce(
+            pool, pool_dev,
+            [(dg, j, d) for dg, (j, d) in produce.items()], pcache)
+        lane0 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[0].copy(), self.init_batch())
+        ds_treedef = jax.tree_util.tree_structure(lane0.delay_state)
+        rows: List[Any] = []
+        bank_index: dict = {}
+        fork_src = np.full(pool.num_jobs, -1, np.int32)
+        fork_depth = np.zeros(pool.num_jobs, np.int32)
+        for j in exec_jobs:
+            if j in shadows or j not in fork_of:
+                continue
+            dg, d = fork_of[j]
+            ri = bank_index.get(dg)
+            if ri is None:
+                got = pcache.get_ckpt(dg)
+                if got is None:
+                    # produced-then-evicted under a tight byte cap —
+                    # this leader falls back to cold admission
+                    del fork_of[j]
+                    continue
+                depth, leaves = got
+                if int(depth) != int(d):
+                    raise PrefixCacheError(
+                        f"prefix cache entry {dg[:12]}… claims depth "
+                        f"{int(depth)} but the pool's chain puts this "
+                        f"digest at depth {int(d)} — refusing the fork")
+                ds = jax.tree_util.tree_unflatten(
+                    ds_treedef, list(leaves.pop("delay_state")))
+                ri = len(rows)
+                rows.append(lane0._replace(delay_state=ds, **leaves))
+                bank_index[dg] = ri
+            fork_src[j] = ri
+            fork_depth[j] = np.int32(d)
+        se = (MEMO_SHADOW_EVERY if shadow_every is None
+              else int(shadow_every))
+        forked = [j for j in exec_jobs
+                  if j in fork_of and j not in shadows]
+        fork_shadows = ([j for k, j in enumerate(forked)
+                         if k % se == 0] if se else [])
+        self._fork_depths = [int(fork_of[j][1]) for j in forked]
+        nbank = 1 << ((len(rows) - 1).bit_length() if rows else 0)
+        while len(rows) < nbank:
+            rows.append(lane0)
+        bank = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *rows)
+        return {"cache": pcache, "fork_of": fork_of,
+                "fork_shadows": fork_shadows,
+                "produced": sorted(produce),
+                "bank_dev": jax.tree_util.tree_map(jnp.asarray, bank),
+                "fork_src_dev": jnp.asarray(fork_src),
+                "fork_depth_dev": jnp.asarray(fork_depth)}
+
+    def _run_cold_jobs(self, pool: JobPool, js, stretch: int,
+                       drain_chunk: int) -> dict:
+        """The audited jobs re-executed cold, together — the fork shadow
+        audit's reference: a sub-pool of exactly those jobs (the FULL
+        pooled phase table is kept so cursor addressing is unchanged)
+        driven through the memo-off streaming step in ONE multi-lane
+        run. Per-job results are lane-independent (admission rebuilds a
+        lane entirely from the job's pool identity rows), so batching
+        the shadows is bit-identical to re-running each alone while
+        costing ~1/B of the device steps — without it the audit would
+        hand back most of the fork plane's win. Returns {job: ring row}
+        keyed by ORIGINAL pool job index."""
+        idx = np.asarray([int(j) for j in js], np.int64)
+        sub = pool._replace(
+            job_start=np.ascontiguousarray(np.asarray(pool.job_start)[idx]),
+            job_end=np.ascontiguousarray(np.asarray(pool.job_end)[idx]),
+            job_limit=np.ascontiguousarray(
+                np.asarray(pool.job_limit)[idx]),
+            fault_key=np.ascontiguousarray(
+                np.asarray(pool.fault_key)[idx]),
+            digest=np.zeros((len(idx), 32), np.uint8),
+            delay_state=jax.tree_util.tree_map(
+                lambda x: np.ascontiguousarray(np.asarray(x)[idx]),
+                pool.delay_state),
+            prefix_digest=None)
+        step = self._stream_step(stretch, drain_chunk, False,
+                                 serve=False, memo="off")
+        sub_dev = jax.tree_util.tree_map(jnp.asarray, sub)
+        state = self.init_batch()
+        stream = self.init_stream(sub)
+        for _ in range(1_000_000):
+            state, stream = step(state, stream, sub_dev)
+            if int(jax.device_get(stream.jobs_done)) >= len(idx):
+                break
+        else:
+            raise RuntimeError(
+                f"cold re-execution of jobs {list(js)} failed to retire")
+        return {int(idx[r["job"]]): dict(r, job=int(idx[r["job"]]))
+                for r in _ring_rows(stream)}
+
+    def _prefix_finalize(self, state, stream, plan: dict, pplan: dict,
+                         pool: JobPool, stretch: int, drain_chunk: int):
+        """After the device loop and _memo_finalize: run the fork shadow
+        audit (the chosen forked leaders re-executed cold in one batched
+        run, byte-compared against their forked harvests), stamp fork
+        provenance
+        on every forked leader's results row, flush the prefix cache and
+        set the host-side prefix books. prefix_hits (host count of
+        planned forks) == forked_jobs (device-accumulated at admission)
+        is the books-balance invariant the chaos drill checks."""
+        ring = {r["job"]: r for r in _ring_rows(stream)}
+        digests = plan["digests"]
+
+        def summary_of(row):
+            return {k: v for k, v in row.items()
+                    if k not in ("job", "admit_step")}
+
+        audited = [j for j in pplan["fork_shadows"] if j in ring]
+        cold_rows = (self._run_cold_jobs(pool, audited, stretch,
+                                         drain_chunk) if audited else {})
+        nshadow = 0
+        for j in audited:
+            dg, d = pplan["fork_of"][j]
+            cold = cold_rows.get(j)
+            nshadow += 1
+            if cold is None or summary_of(cold) != summary_of(ring[j]):
+                raise PrefixCacheError(
+                    f"fork shadow: job {j}, forked at depth {d} from "
+                    f"prefix {dg[:12]}…, disagrees with its cold "
+                    f"re-execution — the checkpointed prefix is not "
+                    f"bit-exact; refusing to serve forks from it")
+        for j, (dg, d) in pplan["fork_of"].items():
+            r = ring.get(j)
+            if r is None:
+                continue
+            row = dict(r)
+            row["digest"] = digests[j]
+            row["served_from"] = f"prefix:{d}"
+            self._memo_rows[j] = row
+        pcache = pplan["cache"]
+        pcache.flush()
+        self._prefix_stats = {
+            "prefix_evictions": pcache.evictions,
+            "prefix_evicted_bytes": pcache.evicted_bytes,
+            "prefix_store_entries": len(pcache)}
+        stream = stream._replace(
+            prefix_hits=np.int32(len(pplan["fork_of"])),
+            shadow_checks=stream.shadow_checks + np.int32(nshadow))
+        return state, stream
+
     def run_stream(self, jobs, *, stretch: int = 4, drain_chunk: int = 32,
                    admission: str = "stream",
                    results_capacity: Optional[int] = None,
@@ -1676,8 +2171,14 @@ class BatchedRunner:
         representative's summary at the end (stream_results rows carry
         ``digest`` + ``served_from`` provenance). With memo == 'full',
         lanes whose state signature recurs mid-drain are fast-forwarded
-        to their tick limit (_ff_host). ``shadow_every`` overrides
-        MEMO_SHADOW_EVERY for the bit-exactness audit (0 disables)."""
+        to their tick limit (_ff_host). With memo == 'prefix', executing
+        leaders additionally fork from the deepest checkpointed phase
+        boundary their digest chain shares with the prefix cache
+        (_prefix_plan), skipping the shared prefix entirely; forked rows
+        carry ``served_from="prefix:<depth>"``. ``shadow_every``
+        overrides MEMO_SHADOW_EVERY for BOTH bit-exactness audits — the
+        duplicate shadow lanes and the cold solo re-runs of forked
+        leaders (0 disables)."""
         from chandy_lamport_tpu.utils.checkpoint import save_state
 
         if admission not in ("stream", "gang"):
@@ -1688,6 +2189,10 @@ class BatchedRunner:
         jcount = pool.num_jobs
         memo = self.memo
         self._memo_rows = {}
+        self._fork_depths = []
+        self._prefix_stats = {"prefix_evictions": 0,
+                              "prefix_evicted_bytes": 0,
+                              "prefix_store_entries": 0}
         if memo == "off":
             plan = order_dev = followers_dev = None
             target = jcount
@@ -1703,6 +2208,12 @@ class BatchedRunner:
             stream = self.init_stream(pool, results_capacity)
         step = self._stream_step(stretch, drain_chunk, admission == "gang")
         pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
+        pplan = None
+        if memo == "prefix":
+            # fork plan + producer dispatches run BEFORE the armed loop:
+            # planning is host work and the producer is ordinary
+            # (unguarded) device traffic
+            pplan = self._prefix_plan(pool, pool_dev, plan, shadow_every)
         # fast-forward needs signature recurrence to imply a frozen lane;
         # periodic re-initiation is clock-driven, so it is fenced off here
         # (the armed-deadline fence in _ff_step covers snapshot_timeout)
@@ -1725,6 +2236,12 @@ class BatchedRunner:
                 for _ in range(int(max_steps)):
                     if memo == "off":
                         state, stream = step(state, stream, pool_dev)
+                    elif memo == "prefix":
+                        state, stream = step(
+                            state, stream, pool_dev, order_dev,
+                            followers_dev, None, None, None, None,
+                            pplan["bank_dev"], pplan["fork_src_dev"],
+                            pplan["fork_depth_dev"])
                     else:
                         state, stream = step(state, stream, pool_dev,
                                              order_dev, followers_dev)
@@ -1756,6 +2273,9 @@ class BatchedRunner:
                         f"machine should make impossible)")
         if memo != "off":
             state, stream = self._memo_finalize(state, stream, plan)
+        if memo == "prefix":
+            state, stream = self._prefix_finalize(
+                state, stream, plan, pplan, pool, stretch, drain_chunk)
         return state, stream
 
     def stream_results(self, stream: StreamState) -> List[dict]:
@@ -1785,6 +2305,11 @@ class BatchedRunner:
         # LRU eviction books of the most recent memoized run's cache
         d.update(getattr(self, "_memo_cache_stats", None)
                  or {"cache_evictions": 0, "cache_evicted_bytes": 0})
+        # prefix-plane books (memo="prefix"): checkpoint-store LRU
+        # pressure + resident entry count after the last flush
+        d.update(getattr(self, "_prefix_stats", None)
+                 or {"prefix_evictions": 0, "prefix_evicted_bytes": 0,
+                     "prefix_store_entries": 0})
         return d
 
     # -- aggregate metrics (jit-friendly reductions; under a sharded batch

@@ -183,9 +183,17 @@ class ExecutableCache:
             except Exception as exc:  # degrade, never fail the run
                 call, disk_error = None, f"{type(exc).__name__}: {exc}"
         if call is None:
+            # serve compiles the memo-off admission (coalescing is host
+            # work at ingest) EXCEPT under memo="prefix", whose fork
+            # scatter lives inside the jitted step; the prefix variant
+            # takes three extra operands (bank, fork_src, fork_depth),
+            # so its avals — and therefore its bucket — can never
+            # collide with a 9-operand artifact
             fn = jax.jit(
-                runner._build_stream_step(stretch, drain_chunk, False,
-                                          "off", True),
+                runner._build_stream_step(
+                    stretch, drain_chunk, False,
+                    "prefix" if runner.memo == "prefix" else "off",
+                    True),
                 donate_argnums=(0, 1))
             call = fn.lower(*abstract).compile()
             if apath:

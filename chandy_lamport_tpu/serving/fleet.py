@@ -96,7 +96,19 @@ def recipe_runner(recipe: Optional[dict]):
                         int(recipe.get("delay_seed", 7))),
         int(recipe.get("batch", 2)),
         scheduler=recipe.get("scheduler", "sync"),
-        memo="off", memo_cache=recipe.get("memo_cache"))
+        # memo stays "off" unless the recipe opts in: the worker loop
+        # already serves exact duplicates from the shared SummaryCache
+        # itself. memo="prefix" + a shared ``prefix_cache`` path makes
+        # each cold execution fork from the deepest boundary ANY worker
+        # checkpointed: singleton pools pin content rank 0, so the chain
+        # digests agree fleet-wide — request 1 bumps the seen heat,
+        # request 2 produces the checkpoint (and forks from it), every
+        # later near-duplicate forks free, across worker restarts.
+        memo=recipe.get("memo", "off"),
+        memo_cache=recipe.get("memo_cache"),
+        prefix_cache=recipe.get("prefix_cache"),
+        prefix_cache_entries=int(recipe.get("prefix_cache_entries", 0)),
+        prefix_cache_bytes=int(recipe.get("prefix_cache_bytes", 0)))
 
 
 def _chaos_maybe_kill(chaos: Optional[dict], leased_jobs) -> None:
@@ -187,8 +199,13 @@ def worker_serve(worker_id: str, spool: AdmissionSpool, runner=None, *,
                 _, stream = runner.run_stream(spool_, stretch=stretch,
                                               drain_chunk=drain_chunk)
                 (row,) = runner.stream_results(stream)
+                # under memo="prefix" the executed row can carry fork
+                # provenance (digest/served_from="prefix:<d>"); the
+                # committed summary must stay provenance-free so forked
+                # and cold executions commit identical bytes
                 summ = {k: v for k, v in row.items()
-                        if k not in ("job", "admit_step")}
+                        if k not in ("job", "admit_step", "digest",
+                                     "served_from")}
                 cache.put(dg, summ)
                 dirty = True
                 rows[r.job] = {**summ, "digest": dg,

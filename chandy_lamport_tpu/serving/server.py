@@ -155,6 +155,10 @@ def serve_run(runner, requests: List[ServeRequest], *,
         stream = runner.init_stream(pool, rcap, tenants=tenants,
                                     tenant_quota=quota_arr)
     runner._memo_rows = {}
+    runner._fork_depths = []
+    runner._prefix_stats = {"prefix_evictions": 0,
+                            "prefix_evicted_bytes": 0,
+                            "prefix_store_entries": 0}
 
     arrival_host = np.asarray([r.arrival_step for r in requests], np.int32)
     tenant_dev = jnp.asarray([r.tenant for r in requests], np.int32)
@@ -163,6 +167,20 @@ def serve_run(runner, requests: List[ServeRequest], *,
                                np.int32)
     pool_dev = jax.tree_util.tree_map(jnp.asarray, pool)
     exec_order = np.full(max(n_exec, 1), -1, np.int32)
+
+    # prefix plane (runner memo="prefix"): plan speculative forks over
+    # the ingest plan's exec set — near-duplicate requests fork from the
+    # deepest checkpointed phase boundary instead of admitting cold. The
+    # fork arrays are JOB-indexed, so the loop's per-step re-sort of the
+    # un-admitted exec-order suffix never invalidates them. Runs before
+    # the armed loop (the producer is ordinary device traffic); a shared
+    # file-backed PrefixCache (runner ``prefix_cache`` knob) lets fleet
+    # workers fork from checkpoints their siblings flushed.
+    pplan = None
+    if runner.memo == "prefix":
+        pplan = runner._prefix_plan(
+            pool, pool_dev, {"exec": list(plan["exec"]), "shadows": set()},
+            None)
 
     # -- host books ------------------------------------------------------
     admitted: set = set()
@@ -224,12 +242,16 @@ def serve_run(runner, requests: List[ServeRequest], *,
     # -- executable warmup (serving.executables) -------------------------
     warm = {"warmup_s": 0.0, "source": None, "persisted": False}
     call = None
+    fork_ops = (() if pplan is None
+                else (pplan["bank_dev"], pplan["fork_src_dev"],
+                      pplan["fork_depth_dev"]))
     if n_exec and done_exec < n_exec:
         exec_cache = exec_cache or ExecutableCache(None)
         call = exec_cache.step_for(
             runner, stretch, drain_chunk,
             (state, stream, pool_dev, jnp.asarray(exec_order), None,
-             np.int32(0), tenant_dev, arrival_dev, deadline_dev))
+             np.int32(0), tenant_dev, arrival_dev, deadline_dev)
+            + fork_ops)
         warm = {"warmup_s": round(exec_cache.last["warmup_s"], 3),
                 "source": exec_cache.last["source"],
                 "persisted": exec_cache.last["persisted"]}
@@ -292,7 +314,7 @@ def serve_run(runner, requests: List[ServeRequest], *,
                 None,
                 guarded_put(guards, "serve-admission-limit",
                             np.int32(limit)),
-                tenant_dev, arrival_dev, deadline_dev)
+                tenant_dev, arrival_dev, deadline_dev, *fork_ops)
             ingest_upto(steps_now + 1)
             prev = consumed
             consumed, steps_now, done_exec = (int(x) for x in guarded_get(
@@ -366,6 +388,20 @@ def serve_run(runner, requests: List[ServeRequest], *,
     stream = stream._replace(
         cache_hits=np.int32(books["cache_served"]),
         coalesced_jobs=np.int32(ncoal))
+    pref_books = {"prefix_hits": 0, "forked_jobs": 0,
+                  "fork_depth_mean": 0.0}
+    if pplan is not None:
+        # fork provenance + shadow audit + prefix-cache flush, exactly
+        # run_stream's finalize arm (only plan["digests"] is consulted)
+        state, stream = runner._prefix_finalize(
+            state, stream, {"digests": digests}, pplan, pool,
+            stretch, drain_chunk)
+        fj, fds = (int(x) for x in jax.device_get(
+            (stream.forked_jobs, stream.fork_depth_sum)))
+        pref_books = {"prefix_hits": int(stream.prefix_hits),
+                      "forked_jobs": fj,
+                      "fork_depth_mean": round(fds / fj, 4) if fj
+                      else 0.0}
 
     host = jax.device_get((stream.deadline_misses, stream.tenant_served,
                            stream.lane_steps_live,
@@ -386,7 +422,7 @@ def serve_run(runner, requests: List[ServeRequest], *,
             (books["cache_served"] + ncoal) / max(nserved, 1), 4),
         "tenant_served": np.asarray(served_t).astype(int).tolist(),
         "tenant_quota": quota_arr.astype(int).tolist(),
-        "wall_s": round(wall_s, 3), **_percentiles(admit_all),
+        "wall_s": round(wall_s, 3), **pref_books, **_percentiles(admit_all),
         "warmup_s": warm["warmup_s"], "warmup_source": warm["source"],
         "warmup_persisted": warm["persisted"],
         # serve honesty: which kernel served the run, and whether the

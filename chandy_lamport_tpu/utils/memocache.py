@@ -33,11 +33,12 @@ forever.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import json
 import os
 from collections import OrderedDict
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -301,6 +302,330 @@ class SummaryCache:
                     # after the replace leaves the new name torn
                     fsync_file(f)
                 crash_failpoint("memocache-replace")
+                os.replace(tmp, self.path)
+                fsync_dir(self.path)
+                self._dirty = False
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+
+# ---------------------------------------------------------------------------
+# prefix plane: rolling phase-boundary digests + checkpointed lane states
+# ---------------------------------------------------------------------------
+
+# THE prefix-cache schema version: one named registry constant, same
+# discipline as MEMOCACHE_SCHEMA_VERSION above (tools/staticcheck's
+# prefix-schema rule pins it to this single int-literal assignment and
+# keeps restated literals out of the stamping dicts). Bumped on any
+# breaking change of the prefix entry layout, the leaf encoding, or the
+# chain recipe — a recipe change MUST bump it, or old chain digests
+# would alias checkpoints of different computations.
+PREFIXCACHE_SCHEMA_VERSION = 1
+
+
+class PrefixCacheError(MemoCacheError):
+    """A prefix cache file could not be read or validated, or a forked
+    job's shadow re-execution contradicted its cold run. Subclasses
+    MemoCacheError (same refusal philosophy: a checkpoint store that
+    guesses forks lanes into the wrong simulation)."""
+
+
+def prefix_seed_digest(*, topo_spec, fault_key, delay_row, scheduler: str,
+                       knobs: Dict[str, str],
+                       config_fields: Dict[str, Any]) -> bytes:
+    """Link zero of a job's prefix-digest chain: sha256 over the job's
+    SCRIPT-FREE identity — exactly the ``job_digest`` recipe minus the
+    script rows, plus a plane tag so a seed digest can never alias a
+    whole-job digest. Two jobs share chain link d iff they share this
+    identity AND their first d compiled script rows are byte-equal, so
+    a checkpoint produced under one job's identity forks bit-exactly
+    into any chain-sharing job."""
+    payload = {
+        "schema": PREFIXCACHE_SCHEMA_VERSION,
+        "plane": "prefix",
+        "nodes": _canon(sorted((str(k), int(v)) for k, v in topo_spec.nodes)),
+        "links": _canon(sorted((str(s), str(d)) for s, d in topo_spec.links)),
+        "fault_key": _canon(fault_key),
+        "delay_row": _canon(delay_row),
+        "scheduler": str(scheduler),
+        "knobs": _canon(knobs),
+        "config": _canon(config_fields),
+    }
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).digest()
+
+
+def prefix_extend(prev: bytes, row) -> bytes:
+    """One chain step: c_{i+1} = sha256(c_i || canon(script row i)).
+    ``row`` is the (kind, arg0, arg1, do_tick) tuple of ONE compiled
+    phase. Rolling rather than hash-of-prefix so pack_jobs pays O(rows)
+    per job, not O(rows^2)."""
+    blob = json.dumps(_canon(list(row)), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(prev + blob.encode()).digest()
+
+
+def _enc_leaf(x: Any) -> dict:
+    """Exact JSON encoding of a checkpoint leaf: ndarrays become
+    (dtype, shape, base64 raw bytes) — byte-lossless, unlike _canon's
+    tolist (which exists for digesting, not round-tripping) — and
+    tuples/lists recurse (delay-sampler states are tuples of arrays)."""
+    if isinstance(x, (tuple, list)):
+        return {"t": [_enc_leaf(v) for v in x]}
+    a = np.asarray(x)
+    return {"d": str(a.dtype), "s": list(a.shape),
+            "b": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _dec_leaf(node: Any, path: str) -> Any:
+    """Strict inverse of _enc_leaf; raises PrefixCacheError on any
+    malformed node (naming ``path``) instead of guessing."""
+    if not isinstance(node, dict):
+        raise PrefixCacheError(
+            f"prefix cache {path}: checkpoint leaf is not an object")
+    if "t" in node:
+        if not isinstance(node["t"], list):
+            raise PrefixCacheError(
+                f"prefix cache {path}: checkpoint tuple node is not a "
+                f"list")
+        return tuple(_dec_leaf(v, path) for v in node["t"])
+    try:
+        dtype = np.dtype(node["d"])
+        shape = tuple(int(s) for s in node["s"])
+        raw = base64.b64decode(node["b"], validate=True)
+        arr = np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+    except (KeyError, TypeError, ValueError) as exc:
+        raise PrefixCacheError(
+            f"prefix cache {path}: checkpoint array node is damaged "
+            f"({exc})") from exc
+    return arr
+
+
+def _read_prefix_entries(path: str) -> "OrderedDict[str, dict]":
+    """Strict parse of a prefix cache file into an OrderedDict in file
+    order (file order is recency order, like SummaryCache). Entry
+    layout: ``{"schema": PREFIXCACHE_SCHEMA_VERSION, "digest": <64
+    hex>, "depth": <phases>, "seen": <count>, "ckpt": null |
+    {"leaves": {...}}}``. Raises PrefixCacheError on any damage."""
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as exc:
+        raise PrefixCacheError(
+            f"prefix cache {path}: unreadable ({exc})") from exc
+    for lineno, line in enumerate(raw.splitlines(), start=1):
+        if not line.strip():
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError as exc:
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} is not valid JSON "
+                f"(poisoned or truncated write: {exc})") from exc
+        if not isinstance(entry, dict) or not {
+                "schema", "digest", "depth", "seen", "ckpt"} <= set(entry):
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} is missing the "
+                f"schema/digest/depth/seen/ckpt keys — not a prefix "
+                f"cache entry")
+        if entry["schema"] != PREFIXCACHE_SCHEMA_VERSION:
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} has schema version "
+                f"{entry['schema']!r}; this build reads only "
+                f"v{PREFIXCACHE_SCHEMA_VERSION} (a schema bump changes "
+                f"the chain recipe or the leaf encoding — stale "
+                f"checkpoints must not be forked from; delete the file "
+                f"to rebuild it)")
+        digest = entry["digest"]
+        if (not isinstance(digest, str)
+                or len(digest) != _DIGEST_HEX_LEN
+                or any(c not in "0123456789abcdef" for c in digest)):
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} digest "
+                f"{digest!r} is not a sha256 hex string")
+        if not isinstance(entry["depth"], int) or entry["depth"] < 1:
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} depth "
+                f"{entry['depth']!r} is not a positive phase count")
+        if not isinstance(entry["seen"], int) or entry["seen"] < 0:
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} seen count "
+                f"{entry['seen']!r} is not a non-negative int")
+        ckpt = entry["ckpt"]
+        if ckpt is not None and not (
+                isinstance(ckpt, dict)
+                and isinstance(ckpt.get("leaves"), dict)):
+            raise PrefixCacheError(
+                f"prefix cache {path}: line {lineno} ckpt is neither "
+                f"null nor a leaves object")
+        out[digest] = {"depth": entry["depth"], "seen": entry["seen"],
+                       "ckpt": ckpt}
+    return out
+
+
+class PrefixCache:
+    """The persistent prefix-checkpoint store (memo="prefix" plane's
+    host side), beside SummaryCache with the same discipline: strict
+    load, atomic locked flush, LRU by entries AND bytes. Content
+    address = a chain digest (prefix_seed_digest + prefix_extend per
+    phase row); an entry carries the boundary ``depth``, a ``seen``
+    counter (how many admissions crossed this boundary without a
+    checkpoint existing yet — the heat signal that promotes a boundary
+    to checkpointed on its next encounter), and optionally the ``ckpt``
+    itself: the lane's semantic DenseState leaves at the boundary,
+    byte-losslessly encoded (_enc_leaf). ``max_bytes`` matters here far
+    more than for summaries — one ring-8 checkpoint is tens of KB, so
+    the LRU is the line between "cache" and "unbounded state dump"."""
+
+    def __init__(self, path: Optional[str], max_entries: int = 0,
+                 max_bytes: int = 0):
+        if max_entries < 0 or max_bytes < 0:
+            raise ValueError("cache capacity bounds must be >= 0")
+        self.path = path
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._nbytes: Dict[str, int] = {}
+        self._total_bytes = 0
+        self._dirty = False
+        if path is not None and os.path.exists(path):
+            self._load(path)
+            self._evict()
+
+    @staticmethod
+    def _line_bytes(digest: str, entry: dict) -> int:
+        return len(json.dumps(
+            {"schema": PREFIXCACHE_SCHEMA_VERSION, "digest": digest,
+             "depth": entry["depth"], "seen": entry["seen"],
+             "ckpt": entry["ckpt"]}, sort_keys=True)) + 1
+
+    def _charge(self, digest: str, entry: dict) -> None:
+        self._total_bytes -= self._nbytes.get(digest, 0)
+        nb = self._line_bytes(digest, entry)
+        self._nbytes[digest] = nb
+        self._total_bytes += nb
+
+    def _evict(self) -> None:
+        while self._entries and (
+                (self.max_entries
+                 and len(self._entries) > self.max_entries)
+                or (self.max_bytes
+                    and self._total_bytes > self.max_bytes)):
+            digest, _ = self._entries.popitem(last=False)
+            nb = self._nbytes.pop(digest)
+            self._total_bytes -= nb
+            self.evictions += 1
+            self.evicted_bytes += nb
+            self._dirty = True
+
+    def _load(self, path: str) -> None:
+        with locked(path, shared=True):
+            entries = _read_prefix_entries(path)
+        for digest, entry in entries.items():
+            self._entries[digest] = entry
+            self._entries.move_to_end(digest)
+            self._charge(digest, entry)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, digest: str) -> bool:
+        return digest in self._entries
+
+    def seen(self, digest: str) -> int:
+        entry = self._entries.get(digest)
+        return int(entry["seen"]) if entry is not None else 0
+
+    def bump_seen(self, digest: str, depth: int) -> None:
+        """Record one checkpoint-less crossing of a boundary. Does NOT
+        refresh LRU recency — heat alone must not out-compete real
+        checkpoints for residency."""
+        entry = self._entries.get(digest)
+        if entry is None:
+            entry = {"depth": int(depth), "seen": 1, "ckpt": None}
+            self._entries[digest] = entry
+            self._entries.move_to_end(digest, last=False)
+        else:
+            entry["seen"] = int(entry["seen"]) + 1
+        self._charge(digest, entry)
+        self._dirty = True
+        self._evict()
+
+    def has_ckpt(self, digest: str) -> bool:
+        entry = self._entries.get(digest)
+        return entry is not None and entry["ckpt"] is not None
+
+    def get_ckpt(self, digest: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """(depth, decoded leaves dict) of a checkpointed boundary, or
+        None. A hit refreshes LRU recency."""
+        entry = self._entries.get(digest)
+        if entry is None or entry["ckpt"] is None:
+            return None
+        self._entries.move_to_end(digest)
+        leaves = {
+            str(name): _dec_leaf(node, self.path or "<memory>")
+            for name, node in entry["ckpt"]["leaves"].items()}
+        return int(entry["depth"]), leaves
+
+    def put_ckpt(self, digest: str, depth: int,
+                 leaves: Dict[str, Any]) -> None:
+        prev = self._entries.get(digest)
+        entry = {"depth": int(depth),
+                 "seen": int(prev["seen"]) if prev else 0,
+                 "ckpt": {"leaves": {str(k): _enc_leaf(v)
+                                     for k, v in leaves.items()}}}
+        self._entries[digest] = entry
+        self._entries.move_to_end(digest)
+        self._charge(digest, entry)
+        self._dirty = True
+        self._evict()
+
+    def flush(self) -> None:
+        """Atomic locked read-merge-write, SummaryCache.flush's
+        discipline verbatim, plus a prefix-specific merge rule for
+        digests both sides hold: a checkpoint beats a seen-only entry
+        (never downgrade a boundary another process promoted), and
+        ``seen`` merges as max — a heat signal should survive
+        concurrent writers, not reset to the last writer's count."""
+        if self.path is None or not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        with locked(self.path):
+            if os.path.exists(self.path):
+                disk = _read_prefix_entries(self.path)
+                for digest in reversed(disk):
+                    mine = self._entries.get(digest)
+                    if mine is None:
+                        self._entries[digest] = disk[digest]
+                        self._entries.move_to_end(digest, last=False)
+                        self._charge(digest, disk[digest])
+                        continue
+                    theirs = disk[digest]
+                    mine["seen"] = max(int(mine["seen"]),
+                                       int(theirs["seen"]))
+                    if mine["ckpt"] is None and theirs["ckpt"] is not None:
+                        mine["ckpt"] = theirs["ckpt"]
+                        mine["depth"] = theirs["depth"]
+                    self._charge(digest, mine)
+                self._evict()
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for digest, entry in self._entries.items():
+                        f.write(json.dumps(
+                            {"schema": PREFIXCACHE_SCHEMA_VERSION,
+                             "digest": digest, "depth": entry["depth"],
+                             "seen": entry["seen"],
+                             "ckpt": entry["ckpt"]},
+                            sort_keys=True) + "\n")
+                    fsync_file(f)
+                crash_failpoint("prefixcache-replace")
                 os.replace(tmp, self.path)
                 fsync_dir(self.path)
                 self._dirty = False

@@ -185,6 +185,17 @@ def stream_counters(stream) -> Dict[str, Any]:
         "ff_skipped_ticks": int(stream.ff_skipped_ticks),
         "shadow_checks": int(stream.shadow_checks),
         "memo_hit_rate": round((hits + coalesced) / served, 4) if served else 0.0,
+        # prefix plane (parallel/batch memo="prefix"): near-duplicate
+        # leaders served from a checkpointed prefix. prefix_hits is the
+        # host plan's fork count, forked_jobs the device admission
+        # counter — equality is the books-balance invariant; the depth
+        # mean is over the device-accumulated fork_depth_sum.
+        "prefix_hits": int(stream.prefix_hits),
+        "forked_jobs": int(stream.forked_jobs),
+        "fork_depth_sum": int(stream.fork_depth_sum),
+        "fork_depth_mean": round(
+            int(stream.fork_depth_sum) / int(stream.forked_jobs), 4)
+        if int(stream.forked_jobs) else 0.0,
         # serving plane (serving/server.py over the v9 leaves): jobs
         # harvested past their absolute deadline, and the per-tenant
         # service/quota books the serve step maintains at harvest

@@ -113,14 +113,16 @@ EV_LANE_COALESCE = 12  # payload = follower count      actor = 0
 EV_MEMO_HIT = 13     # payload = ticks fast-forwarded  actor = 0
 EV_SERVE_ADMIT = 14  # payload = admit wait (steps)    actor = 0
 EV_SERVE_MISS = 15   # payload = lateness (steps)      actor = 0
+EV_PREFIX_FORK = 16  # payload = fork depth (phases)   actor = 0
 
 EVENT_KIND_NAMES = (
     "send", "recv", "marker-send", "marker-recv", "snapshot-start",
     "snapshot-end", "supervisor-abort", "supervisor-retry",
     "supervisor-fail", "fault", "lane-admit", "lane-harvest",
-    "lane-coalesce", "memo-hit", "serve-admit", "serve-miss")
+    "lane-coalesce", "memo-hit", "serve-admit", "serve-miss",
+    "prefix-fork")
 
-_KIND_BITS = 5          # 16 kinds defined, headroom to 31
+_KIND_BITS = 5          # 17 kinds defined, headroom to 31
 _KIND_MASK = (1 << _KIND_BITS) - 1
 
 

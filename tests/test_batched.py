@@ -120,6 +120,8 @@ def test_independent_streams_conserve_tokens_per_lane():
     assert lanes_diverged  # streams actually differ across lanes
 
 
+@pytest.mark.slow  # ~11 s; test_prepare_storm_births_state_in_compiled_formats
+# keeps the AUTO compile path + formats feedback + bit-identity in tier-1
 def test_auto_layouts_matches_default(batched8_default_ref):
     """The bench's --layouts auto path (XLA-chosen jit-boundary layouts,
     VERDICT r4 #6): a storm run under auto_layouts + the state_formats ->
@@ -281,6 +283,9 @@ def test_prepare_storm_births_state_in_compiled_formats(batched8_default_ref):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~10 s; test_auto_layout_rejection_falls_back keeps the
+# mismatched-layout degradation surface in tier-1 (CPU backends usually
+# skip this test's premise anyway)
 def test_relayout_branch_executes_on_mismatched_layouts():
     """Force a genuinely mismatched input layout (a column-major tokens
     plane) so run_storm's compiled-identity relayout branch actually

@@ -162,6 +162,9 @@ def test_worker_row_round_trips_queue_engine(engine, capsys):
     assert cm["elem_ops_per_tick"] > 0
 
 
+@pytest.mark.slow  # ~12 s; graphshard bit-identity stays tier-1 via
+# test_graphshard_script, and the worker-row schema via the queue/kernel
+# engine row tests above — this pins only the comm_engine stamp
 def test_graphshard_worker_row_round_trips_comm_engine(capsys):
     """A real (tiny, CPU) graph-sharded --worker run: the row must carry
     the comm engine and megatick depth that actually ran plus the
@@ -334,6 +337,44 @@ def test_stream_worker_row_round_trips_memo_books(capsys):
     cm = row["cost_model"]
     assert cm["batch"] == 2 and cm["instance_bytes"] > 0
     assert cm["hbm_bytes_per_tick"] == 2 * cm["instance_bytes"] * 2
+
+
+@pytest.mark.slow
+def test_stream_worker_row_round_trips_prefix_books(capsys):
+    """A real (tiny, CPU) --stream --worker A/B/C under memo="prefix": the
+    row must carry the fork books (prefix_hits == forked_jobs, a depth
+    histogram that sums to the fork count) and BOTH denominators — the
+    memo-off baseline and the memo=full exact-match arm — so
+    prefix_speedup in a BENCH row always isolates what forking buys over
+    the best exact-match plane on the identical prefix-packed pool."""
+    rc = bench.main(["--worker", "--stream", "--graph", "ring",
+                     "--nodes", "8", "--batch", "2", "--jobs", "8",
+                     "--snapshots", "2", "--repeats", "1",
+                     "--prefix-overlap", "0.75", "--memo", "prefix"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["metric"] == "stream_jobs_per_sec"
+    assert row["memo"] == "prefix" and row["prefix_overlap"] == 0.75
+    # the books balance: every planned fork was admitted, and the depth
+    # histogram accounts for each forked job at a real chain depth
+    assert row["forked_jobs"] > 0
+    assert row["prefix_hits"] == row["forked_jobs"]
+    assert row["fork_depth_mean"] > 0
+    hist = row["fork_depth_hist"]
+    assert hist and all(int(k) >= 1 for k in hist)
+    assert sum(hist.values()) == row["forked_jobs"]
+    assert row["prefix_evictions"] >= 0
+    # three denominators, one pool: memoized, memo-off, memo=full
+    assert row["effective_jobs_per_sec"] > 0
+    assert row["effective_jobs_per_sec_off"] > 0
+    assert row["effective_jobs_per_sec_full"] > 0
+    assert row["prefix_speedup"] == pytest.approx(
+        row["effective_jobs_per_sec"] / row["effective_jobs_per_sec_full"],
+        rel=1e-2)
+    # at dup_rate 0 the exact-match plane coalesces nothing — the fork
+    # plane is the only thing separating the two memo arms
+    assert row["dup_rate"] == 0.0 and row["coalesced_jobs"] == 0
 
 
 @pytest.mark.slow

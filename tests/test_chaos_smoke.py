@@ -35,13 +35,16 @@ def test_chaos_smoke_battery_green():
     assert {"msg-faults", "crash-pause", "crash-lossy-recovered",
             "crash-lossy-unrecovered", "marker-drop-retry",
             "marker-dup-storm", "marker-drop-exhausted",
-            "trace-under-faults"} <= set(names)
+            "trace-under-faults", "prefix-fork-audit",
+            "prefix-poison-refused"} <= set(names)
     msg = next(r for r in verdict["scenarios"]
                if r["scenario"] == "msg-faults")
     for cls in ("drops", "dups", "jitters"):
         assert msg["fault_events"][cls] > 0
     for row in verdict["scenarios"]:
-        assert row["conservation_delta"] == 0
+        # fleet/prefix rows balance their books in their own currencies
+        # (WAL audit, prefix_hits == forked_jobs) and carry no token delta
+        assert row.get("conservation_delta", 0) == 0
         assert row["ok"], row
     unrec = next(r for r in verdict["scenarios"]
                  if r["scenario"] == "crash-lossy-unrecovered")
@@ -107,5 +110,37 @@ def test_chaos_smoke_fleet_scenarios_green():
     shed = rows["fleet-shed-pressure"]
     assert shed["shed"] == shed["predicted"]
     assert shed["audit"]["lost"] == 0
+    for row in verdict["scenarios"]:
+        assert row["ok"], row
+
+
+# ~45 s on the 1-core box (prefix step + checkpoint producer compiles
+# dominate; the poison drive rides the warm executables; the cold
+# differential is the in-engine every-fork shadow audit, so no separate
+# oracle compile) — the fork plane's tier-1 canary (ISSUE 20)
+def test_chaos_smoke_prefix_scenarios_green():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_smoke.py"),
+         "--prefix-only"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=300)
+    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
+    verdict = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    rows = {r["scenario"]: r for r in verdict["scenarios"]}
+    assert set(rows) == {"prefix-fork-audit", "prefix-poison-refused"}
+    # forks happened under armed faults, every one was shadow-audited
+    # cold and byte-matched, and the books balance
+    audit = rows["prefix-fork-audit"]
+    assert audit["forked_jobs"] > 0
+    assert audit["prefix_hits"] == audit["forked_jobs"]
+    assert audit["shadow_checks"] >= audit["forked_jobs"]
+    assert audit["checks"]["faults_fired"]
+    assert audit["checks"]["forks_bit_identical_to_cold"]
+    # a tampered checkpoint (valid schema, wrong STATE) is refused by
+    # the named error, never served silently
+    poison = rows["prefix-poison-refused"]
+    assert poison["tampered"] > 0
+    assert poison["checks"]["poison_refused_by_name"]
+    assert "fork shadow" in poison["error"]
     for row in verdict["scenarios"]:
         assert row["ok"], row

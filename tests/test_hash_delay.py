@@ -11,6 +11,7 @@ test above it in test_batched.py.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from chandy_lamport_tpu.config import SimConfig
 from chandy_lamport_tpu.core.state import decode_snapshot
@@ -97,6 +98,9 @@ def test_hash_delay_distinct_seeds_distinct_streams():
     assert not np.array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~10 s; test_hash_delay_matches_uniform_summary_shape keeps
+# a hash-delay batched storm in tier-1, and conservation is asserted by
+# every tier-1 storm summary
 def test_hash_delay_storm_lanes_conserve_tokens():
     """Same invariant suite as the UniformJaxDelay lane test
     (test_batched.py): every lane completes every snapshot, conserves

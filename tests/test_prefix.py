@@ -1,0 +1,338 @@
+"""memo="prefix" (ISSUE 20): rolling prefix-digest chains, the
+PrefixCache LRU, and the speculative-fork differential.
+
+The plane's contract, in test order:
+
+* pack_jobs under a memo="prefix" runner stamps a [P, 32] chain of
+  phase-boundary digests over the pooled phase table — chain-sharing
+  jobs (same identity seed + byte-equal leading rows) share links
+  exactly as deep as their scripts agree, and any semantic-identity
+  change (scheduler, delay stream) re-seeds the whole chain;
+* PrefixCache is a real LRU over entries AND bytes: insertion-ordered
+  eviction, ``get_ckpt`` refreshes recency while ``bump_seen`` heat
+  does not, evictions are counted, flush/reload round-trips the
+  checkpoint leaves byte-for-byte, and schema skew is refused loudly;
+* the fork differential: a near-duplicate queue served by forking from
+  cached checkpoints is bit-identical to the memo-off execution of the
+  SAME pool (the prefix runner packs; identity is first-phase-keyed,
+  so per-arm packing would compare different computations), fork
+  provenance rows carry ``served_from="prefix:<depth>"``, the books
+  balance (prefix_hits == forked_jobs), and an undersized cache evicts
+  — counted in the summary — while evicted prefixes fall back to cold
+  admission with results unchanged.
+
+The deep {scheduler} x {faults} sweep and the traced-fork event check
+ride the slow marker; the tier-1 keeper here is the small sync-arm
+differential (the chaos battery's --prefix-only drill keeps the
+fault-armed + poisoned-cache arms in tier-1 via test_chaos_smoke).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from chandy_lamport_tpu.config import SimConfig
+from chandy_lamport_tpu.core.spec import PassTokenEvent, TickEvent
+from chandy_lamport_tpu.models.workloads import ring_topology, stream_jobs
+from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+from chandy_lamport_tpu.parallel.batch import BatchedRunner
+from chandy_lamport_tpu.utils.memocache import (
+    PREFIXCACHE_SCHEMA_VERSION,
+    PrefixCache,
+    PrefixCacheError,
+)
+
+SPEC = ring_topology(8, tokens=16)
+
+
+def make_runner(memo="prefix", delay_seed=7, scheduler="sync", **kw):
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=32)
+    return BatchedRunner(SPEC, cfg, make_fast_delay("hash", delay_seed), 2,
+                         scheduler=scheduler, megatick=2, memo=memo, **kw)
+
+
+def chain_rows(pool, j):
+    s, e = int(pool.job_start[j]), int(pool.job_end[j])
+    return [bytes(bytearray(np.asarray(pool.prefix_digest[r]).tolist()))
+            for r in range(s, e)]
+
+
+def node(i):
+    return sorted({s for s, _ in SPEC.links})[i]
+
+
+# ---------------------------------------------------------------------------
+# digest chains
+
+
+def test_prefix_chains_align_and_diverge_at_the_tail():
+    # B extends A by one phase pair; C is an exact duplicate of A
+    a = [PassTokenEvent(src=node(0), dest=node(1), tokens=1), TickEvent(1)]
+    b = a + [PassTokenEvent(src=node(1), dest=node(2), tokens=1),
+             TickEvent(1)]
+    runner = make_runner()
+    pool = runner.pack_jobs([a, b, list(a)], content_keys=True)
+    assert pool.prefix_digest is not None
+    assert pool.prefix_digest.shape == (pool.kind.shape[0], 32)
+    ca, cb, cc = (chain_rows(pool, j) for j in range(3))
+    # every boundary digest is stamped (no zero rows inside a script)
+    assert all(any(byte for byte in link) for link in ca + cb + cc)
+    # the shared prefix shares the chain, link for link...
+    assert len(cb) > len(ca)
+    assert cb[:len(ca)] == ca
+    # ...and the tail diverges immediately after
+    assert cb[len(ca)] not in ca
+    # an exact duplicate shares the WHOLE chain and the whole-job digest
+    assert cc == ca
+    assert np.array_equal(np.asarray(pool.digest[2]),
+                          np.asarray(pool.digest[0]))
+    # near-duplicates share the first-phase identity: same fault/delay
+    # stream rows (the packer's chain-sharing precondition)
+    for leaf in jax.tree_util.tree_leaves(pool.delay_state):
+        assert np.array_equal(np.asarray(leaf)[0], np.asarray(leaf)[1])
+
+
+def test_prefix_chains_reseed_on_identity_change():
+    a = [PassTokenEvent(src=node(0), dest=node(1), tokens=1), TickEvent(1)]
+    base = chain_rows(make_runner().pack_jobs([a], content_keys=True), 0)
+    for other in (make_runner(scheduler="exact"),
+                  make_runner(delay_seed=8)):
+        rows = chain_rows(other.pack_jobs([a], content_keys=True), 0)
+        # identical script, different execution identity: no link of the
+        # chain may alias — a checkpoint must never fork across them
+        assert not set(rows) & set(base)
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache LRU (satellite: bytes-capped eviction order + counters)
+
+
+def leaves_of(v):
+    return {"tokens": np.full((8,), v, np.int32),
+            "nested": (np.arange(4, dtype=np.int64),
+                       np.float32(v))}
+
+
+def test_prefix_cache_lru_evicts_by_bytes_in_order(tmp_path):
+    path = str(tmp_path / "prefix.jsonl")
+    probe = PrefixCache(None)
+    probe.put_ckpt("a" * 64, 1, leaves_of(1))
+    line = probe._line_bytes("a" * 64, probe._entries["a" * 64])
+    # room for two checkpoints, not three
+    cache = PrefixCache(path, max_bytes=2 * line + line // 2)
+    for i, dg in enumerate(("a" * 64, "b" * 64, "c" * 64)):
+        cache.put_ckpt(dg, i + 1, leaves_of(i))
+    # insertion order IS eviction order: the oldest checkpoint went
+    assert "a" * 64 not in cache
+    assert "b" * 64 in cache and "c" * 64 in cache
+    assert cache.evictions == 1
+    assert cache.evicted_bytes >= line
+    # a get_ckpt refreshes recency, so the NEXT eviction takes "c"
+    depth, leaves = cache.get_ckpt("b" * 64)
+    assert depth == 2
+    assert np.array_equal(leaves["tokens"], leaves_of(1)["tokens"])
+    cache.put_ckpt("d" * 64, 4, leaves_of(3))
+    assert "c" * 64 not in cache
+    assert "b" * 64 in cache and "d" * 64 in cache
+    # flush/reload round-trips the surviving entries byte-for-byte
+    cache.flush()
+    back = PrefixCache(path)
+    assert set(back._entries) == {"b" * 64, "d" * 64}
+    _, reloaded = back.get_ckpt("d" * 64)
+    assert np.array_equal(reloaded["tokens"], leaves_of(3)["tokens"])
+    assert reloaded["nested"][1] == np.float32(3)
+    assert reloaded["nested"][0].dtype == np.int64
+
+
+def test_prefix_cache_seen_heat_does_not_outcompete_checkpoints():
+    probe = PrefixCache(None)
+    probe.put_ckpt("a" * 64, 1, leaves_of(1))
+    line = probe._line_bytes("a" * 64, probe._entries["a" * 64])
+    cache = PrefixCache(None, max_bytes=line + line // 2)
+    cache.put_ckpt("a" * 64, 1, leaves_of(1))
+    # heat-only entries insert at the LRU FRONT: they must be the first
+    # casualties, never the checkpoint they were supposed to promote
+    cache.bump_seen("b" * 64, 2)
+    assert "a" * 64 in cache
+    assert cache.seen("b" * 64) in (0, 1)  # may already be evicted
+    cache.bump_seen("c" * 64, 2)
+    assert "a" * 64 in cache and cache.has_ckpt("a" * 64)
+
+
+def test_prefix_cache_refuses_schema_skew(tmp_path):
+    path = str(tmp_path / "prefix.jsonl")
+    cache = PrefixCache(path)
+    cache.put_ckpt("a" * 64, 3, leaves_of(1))
+    cache.flush()
+    with open(path, "r", encoding="utf-8") as f:
+        entry = json.loads(f.read())
+    entry["schema"] = PREFIXCACHE_SCHEMA_VERSION + 1
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(entry) + "\n")
+    with pytest.raises(PrefixCacheError, match="schema version"):
+        PrefixCache(path)
+
+
+# ---------------------------------------------------------------------------
+# the fork differential + eviction fallback (tier-1 keeper)
+
+
+def strip(row):
+    return {k: v for k, v in row.items()
+            if k not in ("job", "admit_step", "digest", "served_from")}
+
+
+def test_prefix_fork_differential_and_eviction_fallback(tmp_path):
+    """The tier-1 fork keeper: near-duplicate queue, two drives (seed,
+    then fork-from-disk), every fork shadow-audited, byte-compared
+    against the memo-off oracle on the SAME prefix-packed pool; then
+    the cache is capped to one entry and the evicted prefixes must fall
+    back to cold admission with the results unchanged and the eviction
+    counted in the books. (The deep {scheduler} x {faults} sweep is the
+    slow-marker test below; the fault-armed arm stays in tier-1 via the
+    chaos battery's --prefix-only drill.)"""
+    cache = str(tmp_path / "prefix.jsonl")
+    runner = make_runner(prefix_cache=cache)
+    jobs = stream_jobs(SPEC, 6, seed=9, base_phases=2, max_phases=5,
+                      prefix_overlap=0.5)
+    pool = runner.pack_jobs(jobs)
+    for _ in range(2):
+        state, stream = runner.run_stream(pool, stretch=2, drain_chunk=8,
+                                          shadow_every=1)
+    sm = runner.summarize_stream(stream)
+    assert sm["jobs_done"] == 6
+    assert sm["forked_jobs"] > 0
+    assert sm["prefix_hits"] == sm["forked_jobs"]   # the books balance
+    assert sm["fork_depth_mean"] > 0
+    assert sm["shadow_checks"] >= sm["forked_jobs"]  # every fork audited
+    res = {r["job"]: r for r in runner.stream_results(stream)}
+    forked = {j: r for j, r in res.items()
+              if str(r.get("served_from", "")).startswith("prefix:")}
+    assert len(forked) == sm["forked_jobs"]
+    # provenance depth is a real chain depth within the job's script
+    for j, r in forked.items():
+        d = int(str(r["served_from"]).split(":")[1])
+        assert 1 <= d <= int(pool.job_end[j]) - int(pool.job_start[j])
+    # the oracle: a memo-off runner consuming the prefix-packed pool
+    oracle = make_runner(memo="off")
+    _, ostream = oracle.run_stream(pool, stretch=2, drain_chunk=8)
+    ores = {r["job"]: r for r in oracle.stream_results(ostream)}
+    assert sorted(res) == sorted(ores)
+    for j in ores:
+        assert strip(res[j]) == strip(ores[j]), f"job {j} diverged"
+    # -- eviction fallback: cap the store at ONE entry (same runner, so
+    #    the warm executable is reused; the file handle is rebuilt with
+    #    the new caps on the next run) and drive again
+    runner.prefix_cache_entries = 1
+    _, stream2 = runner.run_stream(pool, stretch=2, drain_chunk=8,
+                                   shadow_every=1)
+    sm2 = runner.summarize_stream(stream2)
+    assert sm2["jobs_done"] == 6
+    assert sm2["prefix_evictions"] > 0
+    assert sm2["prefix_evicted_bytes"] > 0
+    assert sm2["prefix_store_entries"] <= 1
+    # evicted prefixes fell back to COLD admission — results unchanged
+    res2 = {r["job"]: r for r in runner.stream_results(stream2)}
+    for j in ores:
+        assert strip(res2[j]) == strip(ores[j]), f"job {j} diverged cold"
+
+
+# ---------------------------------------------------------------------------
+# tools/analyze.py renders the fork books (no engine: synthetic telemetry)
+
+
+def test_analyze_telemetry_renders_prefix_books(tmp_path, capsys):
+    import importlib.util
+    import os
+
+    from chandy_lamport_tpu.utils.tracing import TelemetryWriter
+
+    spec = importlib.util.spec_from_file_location(
+        "clsim_analyze",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "analyze.py"))
+    analyze = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(analyze)
+    path = str(tmp_path / "tel.jsonl")
+    with TelemetryWriter(path) as tw:
+        tw.write("stream_run", {
+            "jobs_done": 4, "memo": "prefix", "prefix_hits": 3,
+            "forked_jobs": 3, "fork_depth_mean": 2.6667,
+            "prefix_evictions": 1, "prefix_speedup": 1.25,
+            "fork_depth_hist": {"2": 2, "4": 1}})
+        for j in range(4):
+            row = {"job": j, "error": 0}
+            if j:
+                row["served_from"] = f"prefix:{2 * ((j + 1) // 2)}"
+            tw.write("stream_job", row)
+    analyze.analyze_telemetry(path)
+    out = capsys.readouterr().out
+    # the run headline carries the fork books + the depth histogram line
+    assert "prefix_hits=3" in out and "prefix_speedup=1.25" in out
+    assert "prefix_evictions=1" in out
+    assert "fork depths: d2:2, d4:1" in out
+    # per-job provenance: hit rate over the harvest + decoded depths
+    assert "3 prefix-forked (hit rate 0.75; d2:2, d4:1)" in out
+
+
+# ---------------------------------------------------------------------------
+# the deep sweep (slow): {sync, exact} x faults, traced fork events
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scheduler", ["sync", "exact"])
+def test_prefix_fork_deep_sweep_under_faults(tmp_path, scheduler):
+    from chandy_lamport_tpu.models.faults import JaxFaults
+    from chandy_lamport_tpu.utils.tracing import (
+        EV_PREFIX_FORK,
+        JaxTrace,
+        decode_trace,
+    )
+
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=64)
+
+    def mk(memo, trace=None):
+        return BatchedRunner(
+            SPEC, cfg, make_fast_delay("hash", 11), 4,
+            scheduler=scheduler, quarantine=True, trace=trace,
+            faults=JaxFaults(3, drop_rate=0.05, dup_rate=0.05,
+                             jitter_rate=0.05),
+            memo=memo,
+            prefix_cache=str(tmp_path / f"prefix-{scheduler}.jsonl"))
+
+    runner = mk("prefix", trace=JaxTrace())
+    jobs = stream_jobs(SPEC, 12, seed=5, base_phases=4, max_phases=10,
+                       prefix_overlap=0.75)
+    pool = runner.pack_jobs(jobs)
+    for _ in range(2):
+        state, stream = runner.run_stream(pool, stretch=2, drain_chunk=8,
+                                          shadow_every=1)
+    sm = runner.summarize_stream(stream)
+    assert sm["jobs_done"] == 12
+    assert sm["forked_jobs"] > 0
+    assert sm["prefix_hits"] == sm["forked_jobs"]
+    assert sm["shadow_checks"] >= sm["forked_jobs"]
+    # the flight recorder saw the forks: EV_PREFIX_FORK events whose
+    # payload is the fork depth
+    host = jax.device_get(state)
+    forks = [e for lane in range(4) for e in decode_trace(host, lane=lane)
+             if e.kind == EV_PREFIX_FORK]
+    assert forks
+    assert all(e.payload >= 1 for e in forks)
+    res = {r["job"]: r for r in runner.stream_results(stream)}
+    oracle = BatchedRunner(
+        SPEC, cfg, make_fast_delay("hash", 11), 4, scheduler=scheduler,
+        quarantine=True,
+        faults=JaxFaults(3, drop_rate=0.05, dup_rate=0.05,
+                         jitter_rate=0.05))
+    _, ostream = oracle.run_stream(pool, stretch=2, drain_chunk=8)
+    ores = {r["job"]: r for r in oracle.stream_results(ostream)}
+    assert sorted(res) == sorted(ores)
+    for j in ores:
+        assert strip(res[j]) == strip(ores[j]), \
+            f"{scheduler}: forked job {j} diverged from cold under faults"
+    # live fault evidence: this sweep forked through armed adversaries,
+    # not a fault-free fast path
+    assert any(r.get("fault_events", 0) > 0 for r in ores.values())

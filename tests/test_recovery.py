@@ -119,7 +119,7 @@ def test_load_stale_format_version_raises_checkpoint_error(
         load_state(path, r.init_batch())
 
 
-@pytest.mark.slow  # ~14 s; the v5 supervisor-leaf roundtrip keeps ckpt leaves in tier-1
+@pytest.mark.slow  # ~14 s; the cli kill-resume test round-trips every leaf in tier-1
 def test_roundtrip_carries_fault_leaves(tmp_path):
     # format v4: the adversary's stream keys and books survive the disk
     # trip, so a resumed faulted run replays the SAME fault program
@@ -133,6 +133,9 @@ def test_roundtrip_carries_fault_leaves(tmp_path):
     _assert_trees_equal(final, restored)
 
 
+@pytest.mark.slow  # ~17 s; test_cli_storm_kill_resume_bit_exact round-trips the
+# FULL current-format state (every leaf bit-exact through save/load +
+# resume) in tier-1 — this leg pins the v5 supervisor-leaf detail
 def test_v5_roundtrip_carries_supervisor_leaves(tmp_path):
     # format v5: the snapshot supervisor's books (epochs, deadlines,
     # retries, initiators, completion ticks, stale tallies) survive the

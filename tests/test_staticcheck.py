@@ -85,7 +85,7 @@ def test_ckpt_history_rejects_version_gap():
 
 _MEMO_KNOB_OK = (
     "ENGINE_KNOBS = {\n"
-    "    \"memo\": (\"off\", \"admit\", \"full\"),\n"
+    "    \"memo\": (\"off\", \"admit\", \"full\", \"prefix\"),\n"
     "}\n"
 )
 _RESOLVE_MEMO_OK = (
@@ -156,9 +156,72 @@ def test_memo_schema_single_named_constant():
     )}) == []
 
 
+_PREFIX_CACHE_OK = (
+    "PREFIXCACHE_SCHEMA_VERSION = 1\n"
+    "class PrefixCache:\n"
+    "    def flush(self):\n"
+    "        with locked(self.path):\n"
+    "            with open(self.path + \".tmp\", \"w\") as f:\n"
+    "                f.write(\"x\")\n"
+    "    def line(self, digest, entry):\n"
+    "        return {\"schema\": PREFIXCACHE_SCHEMA_VERSION,\n"
+    "                \"digest\": digest, \"depth\": entry[\"depth\"],\n"
+    "                \"seen\": 0, \"ckpt\": None}\n"
+)
+
+
+def test_prefix_schema_single_named_constant():
+    # restated literal in a prefix ENTRY dict (depth/ckpt shape)
+    vs = ast_lint.check_prefix_schema({ast_lint.MEMOCACHE_PATH: (
+        "PREFIXCACHE_SCHEMA_VERSION = 1\n"
+        "class PrefixCache:\n"
+        "    def line(self):\n"
+        "        return {\"schema\": 1, \"digest\": \"d\", \"depth\": 2,\n"
+        "                \"seen\": 0, \"ckpt\": None}\n"
+    )})
+    assert any("other than the PREFIXCACHE_SCHEMA_VERSION Name" in v.detail
+               for v in vs), [v.detail for v in vs]
+    # re-assignment outside memocache.py
+    vs = ast_lint.check_prefix_schema({
+        ast_lint.MEMOCACHE_PATH: _PREFIX_CACHE_OK,
+        "chandy_lamport_tpu/parallel/batch.py":
+            "PREFIXCACHE_SCHEMA_VERSION = 2\n"})
+    assert any("lives only in utils/memocache.py" in v.detail
+               for v in vs), [v.detail for v in vs]
+    # a memo SUMMARY line (no depth/ckpt keys) is the other plane's
+    # business — this rule must not claim it
+    vs = ast_lint.check_prefix_schema({ast_lint.MEMOCACHE_PATH: (
+        "PREFIXCACHE_SCHEMA_VERSION = 1\n"
+        "class PrefixCache:\n"
+        "    pass\n"
+        "def memo_line():\n"
+        "    return {\"schema\": 1, \"digest\": \"d\"}\n"
+    )})
+    assert vs == [], [v.detail for v in vs]
+
+
+def test_prefix_schema_requires_locked_writes():
+    # write-mode open inside PrefixCache but OUTSIDE `with locked(...)`
+    vs = ast_lint.check_prefix_schema({ast_lint.MEMOCACHE_PATH: (
+        "PREFIXCACHE_SCHEMA_VERSION = 1\n"
+        "class PrefixCache:\n"
+        "    def flush(self):\n"
+        "        with open(self.path, \"w\") as f:\n"
+        "            f.write(\"x\")\n"
+    )})
+    assert any("outside a `with locked(...)` block" in v.detail
+               for v in vs), [v.detail for v in vs]
+    # read-mode opens are fine unlocked; locked writes are fine
+    assert ast_lint.check_prefix_schema({
+        ast_lint.MEMOCACHE_PATH: _PREFIX_CACHE_OK}) == []
+    # the REAL tree holds the discipline
+    assert [v for v in ast_lint.lint_tree(REPO_ROOT)
+            if v.rule == "prefix-schema"] == []
+
+
 _SERVE_KNOB_OK = (
     "ENGINE_KNOBS = {\n"
-    "    \"memo\": (\"off\", \"admit\", \"full\"),\n"
+    "    \"memo\": (\"off\", \"admit\", \"full\", \"prefix\"),\n"
     "    \"serve_policy\": (\"edf\", \"fifo\"),\n"
     "}\n"
 )

@@ -151,8 +151,8 @@ def test_checkpoint_v6_kill_and_resume_mid_queue(sync_runner, pool,
 
 
 def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
-    # the supported range in the error must have widened to v9 (the
-    # serving-plane format): an operator holding a too-NEW file learns
+    # the supported range in the error must have widened to v10 (the
+    # prefix-fork format): an operator holding a too-NEW file learns
     # both sides of the mismatch
     path = str(tmp_path / "v99.npz")
     tree = {"x": np.zeros(3, np.int32)}
@@ -161,7 +161,7 @@ def test_stale_version_error_names_current_range(tmp_path, monkeypatch):
     monkeypatch.undo()
     with pytest.raises(CheckpointError,
                        match=r"version 99.*supported version range "
-                             r"v\d+\.\.v9"):
+                             r"v\d+\.\.v10"):
         load_state(path, tree)
 
 

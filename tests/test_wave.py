@@ -89,7 +89,7 @@ def test_wave_vs_cascade_random_storms(case_seed):
     _assert_states_identical(a, b)
 
 
-@pytest.mark.slow  # overflow/fixed-delay/random legs keep wave-vs-cascade tier-1
+@pytest.mark.slow  # the capacity-edge leg keeps wave-vs-cascade tier-1
 def test_wave_vs_cascade_marker_pileup():
     """The shape the wave exists for: a complete digraph where every node
     snapshots in the same phase, so single ticks deliver many markers to
@@ -117,6 +117,8 @@ def test_wave_vs_cascade_marker_pileup():
     _assert_states_identical(a, b)
 
 
+@pytest.mark.slow  # ~12 s; test_wave_capacity_edge_matches_cascade keeps the
+# wave-vs-cascade bit-identity differential in tier-1
 def test_wave_matches_cascade_and_parity_fixed_delay():
     """Scalar event path (DenseSim injections + drain) under FixedDelay,
     checked against the parity oracle too: decoded snapshots and final

@@ -33,6 +33,9 @@ def test_config_rejects_bad_log_capacity():
     SimConfig(window_dtype="uint16", max_recorded=64)  # fine
 
 
+@pytest.mark.slow  # ~10 s; test_uint16_exact_scheduler_vs_parity pins uint16
+# windows against the parity oracle (strictly stronger per-window) and
+# test_recorded_window_decodes_across_uint16_wrap pins the wrap — tier-1
 def test_uint16_matches_int32_sync_storm():
     spec = erdos_renyi(24, 2.5, seed=6, tokens=80)
     finals = []

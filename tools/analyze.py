@@ -67,17 +67,25 @@ def analyze_telemetry(path: str) -> None:
     for kind, cnt in sorted(kinds.items()):
         print(f"  {kind:<16} {cnt}")
     # run rows: one headline per row, whatever kind produced it (the memo
-    # plane's hit/coalesce/fast-forward books ride along when present)
+    # plane's hit/coalesce/fast-forward books ride along when present,
+    # and the prefix plane's fork books beside them)
     run_keys = ("value", "unit", "trace_events", "trace_dropped",
                 "error_bits", "jobs_done", "snapshots", "wall_seconds",
                 "memo", "cache_hits", "coalesced_jobs", "ff_skipped_ticks",
-                "shadow_checks", "memo_hit_rate", "effective_jobs_per_sec")
+                "shadow_checks", "memo_hit_rate", "effective_jobs_per_sec",
+                "prefix_hits", "forked_jobs", "fork_depth_mean",
+                "prefix_evictions", "prefix_speedup")
     for r in records:
         if not r["kind"].endswith("_run"):
             continue
         fields = {k: r[k] for k in run_keys if k in r}
         print(f"  {r['kind']}: " + ", ".join(
             f"{k}={v}" for k, v in fields.items()))
+        hist = r.get("fork_depth_hist")
+        if hist:
+            bars = ", ".join(f"d{d}:{hist[d]}"
+                             for d in sorted(hist, key=int))
+            print(f"    fork depths: {bars}")
     events = [r for r in records if r["kind"] == "event"]
     if events:
         hist = Counter(e["event"] for e in events)
@@ -92,12 +100,23 @@ def analyze_telemetry(path: str) -> None:
         served = [j for j in jobs if j.get("served_from")]
         line = (f"  stream jobs: {len(jobs)} harvested, "
                 f"{len(errored)} errored")
+        forked = [j for j in served
+                  if str(j["served_from"]).startswith("prefix:")]
+        served = [j for j in served if j not in forked]
         if served:
             from_cache = sum(1 for j in served
                              if j["served_from"] == "cache")
             line += (f", {len(served)} memo-served "
                      f"({from_cache} cache, "
                      f"{len(served) - from_cache} coalesced)")
+        if forked:
+            # served_from="prefix:<depth>" provenance rows: hit rate over
+            # the whole harvest + the depth histogram of the forks
+            depths = Counter(int(str(j["served_from"]).split(":")[1])
+                             for j in forked)
+            bars = ", ".join(f"d{d}:{depths[d]}" for d in sorted(depths))
+            line += (f", {len(forked)} prefix-forked "
+                     f"(hit rate {len(forked) / len(jobs):.2f}; {bars})")
         print(line)
 
 

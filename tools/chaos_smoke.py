@@ -33,7 +33,16 @@ until deadline-aware shedding drops exactly the predicted victims.
 ``--fleet-only`` runs just that trio (the tier-1 slice — the rest of the
 battery is the slow marker).
 
-Usage: python tools/chaos_smoke.py [--seed S] [--fleet-only]
+The prefix-fork scenario (ISSUE 20, memo="prefix") drills the
+speculative-fork plane under live faults: a near-duplicate queue forked
+from cached prefix checkpoints with the message-plane adversary armed
+must byte-match its cold memo-off re-execution under an every-fork
+shadow audit with balanced books (prefix_hits == forked_jobs), and a
+POISONED PrefixCache (checkpointed token state tampered on disk) must
+be refused loudly by that audit with the named PrefixCacheError, never
+served silently. ``--prefix-only`` runs just it (tier-1 slice).
+
+Usage: python tools/chaos_smoke.py [--seed S] [--fleet-only|--prefix-only]
 Prints one verdict line per scenario (stderr) + a JSON summary (stdout);
 exit 0 iff every scenario held every invariant.
 """
@@ -187,6 +196,135 @@ def fleet_scenarios(seed: int):
     return rows, ok
 
 
+def prefix_scenarios(seed: int):
+    """The prefix-fork chaos drill (module docstring): returns
+    (rows, ok). One near-duplicate queue (prefix_overlap traffic), the
+    message-plane adversary armed on every job, driven twice through a
+    memo="prefix" runner over a shared on-disk PrefixCache so the
+    second drive forks every near-dup from checkpoints — then the SAME
+    cache file is tampered and the next drive must refuse it."""
+    import tempfile
+
+    import jax  # noqa: F401  (imported for the side effect of config)
+
+    from chandy_lamport_tpu.config import SimConfig
+    from chandy_lamport_tpu.models.faults import JaxFaults
+    from chandy_lamport_tpu.models.workloads import (
+        ring_topology,
+        stream_jobs,
+    )
+    from chandy_lamport_tpu.ops.delay_jax import make_fast_delay
+    from chandy_lamport_tpu.parallel.batch import BatchedRunner
+    from chandy_lamport_tpu.utils.memocache import PrefixCacheError
+
+    rows, ok = [], True
+    ring = ring_topology(8, tokens=100)
+    cfg = SimConfig.for_workload(snapshots=2, max_recorded=64)
+    d = tempfile.mkdtemp(prefix="clsim-prefix-chaos-")
+    cache = os.path.join(d, "prefix.jsonl")
+    jcount = 12
+
+    def build(memo):
+        return BatchedRunner(
+            ring, cfg, make_fast_delay("hash", 11), batch=4,
+            scheduler="exact", quarantine=True,
+            faults=JaxFaults(seed, drop_rate=0.05, dup_rate=0.05,
+                             jitter_rate=0.05),
+            memo=memo,
+            prefix_cache=cache)
+
+    jobs = stream_jobs(ring, jcount, seed=seed, base_phases=4,
+                       max_phases=10, prefix_overlap=0.75)
+    runner = build("prefix")
+    pool = runner.pack_jobs(jobs, content_keys=True)
+    # drive 1 seeds checkpoints (in-pool heat already forks followers);
+    # drive 2 forks every near-dup straight from the flushed disk cache.
+    # shadow_every=1: EVERY fork is re-executed cold (a batched memo-off
+    # sub-pool run on the job's own pooled fault/delay identity rows —
+    # the same adversary) and byte-compared inside _prefix_finalize,
+    # which RAISES on any divergence. That audit is this drill's cold
+    # differential; the explicit memo-off-oracle comparison on a prefix
+    # pool lives in tests/test_prefix.py (tier-1 fault-free, slow
+    # faulted sweep) where it guards the audit machinery itself.
+    for _ in range(2):
+        state, stream = runner.run_stream(pool, stretch=2, drain_chunk=8,
+                                          shadow_every=1)
+    sm = runner.summarize_stream(stream)
+    res = {r["job"]: r for r in runner.stream_results(stream)}
+    every_fork_audited = sm["shadow_checks"] >= sm["forked_jobs"]
+    checks = {
+        "forked": sm["forked_jobs"] > 0,
+        "queue_drained": sm["jobs_done"] == jcount,
+        # the books-balance invariant: host-planned forks == device-
+        # admitted forks, nothing served twice or dropped
+        "books_balance": sm["prefix_hits"] == sm["forked_jobs"],
+        "every_fork_audited": every_fork_audited,
+        "faults_fired": any(r.get("fault_events", 0) > 0
+                            for r in res.values()),
+        # the drive completing + every fork audited == each forked job's
+        # summary byte-matched its cold re-execution (mismatch raises)
+        "forks_bit_identical_to_cold": every_fork_audited,
+    }
+    row = {"scenario": "prefix-fork-audit",
+           "forked_jobs": sm["forked_jobs"],
+           "fork_depth_mean": sm["fork_depth_mean"],
+           "prefix_hits": sm["prefix_hits"],
+           "shadow_checks": sm["shadow_checks"],
+           "checks": checks, "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"prefix-fork-audit: {'ok' if row['ok'] else 'FAIL'} "
+        f"forked={sm['forked_jobs']} depth={sm['fork_depth_mean']} "
+        f"shadows={sm['shadow_checks']}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+
+    # -- poison the cache ON DISK: add one token to every checkpointed
+    #    `tokens` leaf (valid JSON, valid schema, valid shapes — only
+    #    the STATE is wrong, the hardest poisoning to catch) and demand
+    #    the next drive's shadow audit refuse it by name instead of
+    #    serving forks from corrupt state.
+    with open(cache, "r", encoding="utf-8") as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines() if ln]
+    import base64
+
+    import numpy as np
+
+    tampered = 0
+    for entry in lines:
+        leaf = (entry.get("ckpt") or {}).get("leaves", {}).get("tokens")
+        if leaf is None:
+            continue
+        arr = np.frombuffer(base64.b64decode(leaf["b"]),
+                            dtype=np.dtype(leaf["d"])).copy()
+        arr.flat[0] += 1
+        leaf["b"] = base64.b64encode(arr.tobytes()).decode("ascii")
+        tampered += 1
+    with open(cache, "w", encoding="utf-8") as f:
+        for entry in lines:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+    refused, msg = False, ""
+    try:
+        # same runner (warm executables): a file-backed PrefixCache is
+        # re-read from disk on every run_stream, so the tamper is seen
+        runner.run_stream(pool, stretch=2, drain_chunk=8, shadow_every=1)
+    except PrefixCacheError as exc:
+        refused, msg = True, str(exc)
+    checks = {
+        "checkpoints_tampered": tampered > 0,
+        "poison_refused_by_name": refused,
+        "audit_named_the_fork": "fork shadow" in msg,
+    }
+    row = {"scenario": "prefix-poison-refused", "tampered": tampered,
+           "error": msg[:160], "checks": checks,
+           "ok": all(checks.values())}
+    ok &= row["ok"]
+    rows.append(row)
+    log(f"prefix-poison-refused: {'ok' if row['ok'] else 'FAIL'} "
+        f"tampered={tampered} refused={refused}"
+        f"{'' if row['ok'] else ' checks=' + str(checks)}")
+    return rows, ok
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--seed", type=int, default=3)
@@ -194,6 +332,8 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--fleet-only", action="store_true",
                    help="run only the serve-fleet scenarios (tier-1 slice)")
+    p.add_argument("--prefix-only", action="store_true",
+                   help="run only the prefix-fork scenarios (tier-1 slice)")
     args = p.parse_args()
 
     # keep off the real TPU chip when run standalone (same contract as the
@@ -201,9 +341,10 @@ def main() -> int:
     if not os.environ.get("CLSIM_KEEP_PLATFORM"):
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    if args.fleet_only:
+    if args.fleet_only or args.prefix_only:
         t0 = time.time()
-        rows, ok = fleet_scenarios(args.seed)
+        rows, ok = (fleet_scenarios(args.seed) if args.fleet_only
+                    else prefix_scenarios(args.seed))
         verdict = {"ok": ok, "scenarios": rows,
                    "elapsed_s": round(time.time() - t0, 1)}
         print(json.dumps(verdict))
@@ -472,6 +613,10 @@ def main() -> int:
     frows, fok = fleet_scenarios(args.seed)
     rows += frows
     ok &= fok
+
+    prows, pok = prefix_scenarios(args.seed)
+    rows += prows
+    ok &= pok
 
     verdict = {"ok": ok, "scenarios": rows,
                "elapsed_s": round(time.time() - t0, 1)}

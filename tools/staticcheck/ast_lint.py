@@ -38,15 +38,24 @@ Rules (ids are stable; the README rule table documents them):
                       differing by op) hides out-of-bounds intent and costs
                       a select XLA can't always elide.
   memo-knob           ENGINE_KNOBS declares the ``memo`` knob with exactly
-                      the off/admit/full ladder ("off" first — the neutral
-                      arm is the default), and ``resolve_memo`` validates
-                      against the table, not a restated inline spelling
-                      tuple that can drift from it.
+                      the off/admit/full/prefix ladder ("off" first — the
+                      neutral arm is the default), and ``resolve_memo``
+                      validates against the table, not a restated inline
+                      spelling tuple that can drift from it.
   memo-schema         MEMOCACHE_SCHEMA_VERSION is ONE module-level int
                       literal in utils/memocache.py; every schema-stamping
                       dict there references the Name (a restated literal
                       would let the written and checked versions diverge),
                       and no other module re-assigns the constant.
+  prefix-schema       PREFIXCACHE_SCHEMA_VERSION is ONE module-level int
+                      literal in utils/memocache.py (no other module may
+                      re-assign it); every prefix-cache entry dict there
+                      (the depth/ckpt shape) stamps ``"schema":`` with
+                      that exact Name; and every write-mode ``open`` in
+                      the PrefixCache class body sits lexically inside a
+                      ``with locked(...)`` block — checkpoints are shared
+                      across serve-fleet processes, so an unlocked write
+                      can tear a checkpoint another worker forks from.
   serve-knob          ENGINE_KNOBS declares ``serve_policy`` with exactly
                       the edf/fifo pair ("edf" first — the default), and
                       ``resolve_serve_policy`` validates against the table,
@@ -107,8 +116,9 @@ ATOMICIO_PATH = "chandy_lamport_tpu/utils/atomicio.py"
 BATCH_PATH = "chandy_lamport_tpu/parallel/batch.py"
 
 # the memo opt-in ladder; "off" first — the table order IS the contract
-# (off is the default and the bit-identity baseline)
-MEMO_SPELLINGS = ("off", "admit", "full")
+# (off is the default and the bit-identity baseline; "prefix" extends
+# "full" with speculative forks from cached prefix checkpoints)
+MEMO_SPELLINGS = ("off", "admit", "full", "prefix")
 
 # the serving admission policies; "edf" first — the default the serve
 # CLI/bench run unless the baseline is asked for explicitly
@@ -545,10 +555,10 @@ def check_scatter_mode(sources: Dict[str, str]) -> List[Violation]:
 
 def check_memo_knob(sources: Dict[str, str]) -> List[Violation]:
     """The memo knob's spellings live in ENGINE_KNOBS and nowhere else:
-    the table row must be exactly the off/admit/full ladder (off first),
-    and ``resolve_memo`` must consult the table by Name instead of
-    restating the spellings in an inline tuple/list/set that would drift
-    when a fourth memo level lands."""
+    the table row must be exactly the off/admit/full/prefix ladder (off
+    first), and ``resolve_memo`` must consult the table by Name instead
+    of restating the spellings in an inline tuple/list/set that would
+    drift when a fifth memo level lands."""
     out: List[Violation] = []
     tree = _parse(sources, CONFIG_PATH)
     if tree is None:
@@ -680,6 +690,125 @@ def check_memo_schema(sources: Dict[str, str]) -> List[Violation]:
                     f"schema stamped with restated literal {v.value} — "
                     f"reference MEMOCACHE_SCHEMA_VERSION so write and "
                     f"check sites cannot diverge"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# prefix-schema
+
+
+def check_prefix_schema(sources: Dict[str, str]) -> List[Violation]:
+    """PREFIXCACHE_SCHEMA_VERSION is a single named registry constant
+    (one module-level int-literal assignment in utils/memocache.py,
+    never re-assigned an int literal elsewhere), every prefix-cache
+    entry dict there — recognizable by its depth/ckpt key shape —
+    stamps ``"schema":`` with that exact Name, and every write-mode
+    ``open`` inside the PrefixCache class sits lexically inside a
+    ``with locked(...)`` block: the checkpoint file is shared across
+    serve-fleet processes, and a torn or unlocked write is state
+    another worker would FORK from (the memo-schema / cache-lock pair's
+    discipline, specialized to the fork plane's store)."""
+    out: List[Violation] = []
+    for path, src in sorted(sources.items()):
+        if path == MEMOCACHE_PATH:
+            continue
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            value = _assign_value(node)
+            if "PREFIXCACHE_SCHEMA_VERSION" in _assign_targets(node) and \
+                    isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int):
+                out.append(Violation(
+                    "prefix-schema", f"{path}:{node.lineno}",
+                    f"PREFIXCACHE_SCHEMA_VERSION = {value.value}: the "
+                    f"prefix cache schema version lives only in "
+                    f"utils/memocache.py — import it, don't shadow it"))
+
+    tree = _parse(sources, MEMOCACHE_PATH)
+    if tree is None:
+        return out + [Violation(
+            "prefix-schema", MEMOCACHE_PATH,
+            "utils/memocache.py not found in lint input")]
+    decls: List[Tuple[ast.stmt, Optional[ast.expr]]] = []
+    for node in tree.body:
+        if "PREFIXCACHE_SCHEMA_VERSION" in _assign_targets(node):
+            decls.append((node, _assign_value(node)))
+    if not decls:
+        out.append(Violation(
+            "prefix-schema", MEMOCACHE_PATH,
+            "no module-level PREFIXCACHE_SCHEMA_VERSION — the checkpoint "
+            "format needs one named registry constant"))
+    elif len(decls) > 1:
+        out.append(Violation(
+            "prefix-schema", f"{MEMOCACHE_PATH}:{decls[1][0].lineno}",
+            "PREFIXCACHE_SCHEMA_VERSION assigned more than once — one "
+            "declaration, one value"))
+    else:
+        value = decls[0][1]
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, int)):
+            out.append(Violation(
+                "prefix-schema", f"{MEMOCACHE_PATH}:{decls[0][0].lineno}",
+                "PREFIXCACHE_SCHEMA_VERSION must be a bare int literal — "
+                "a computed version can change without a reviewable diff"))
+
+    def entry_keys(node: ast.Dict) -> set:
+        return {k.value for k in node.keys
+                if isinstance(k, ast.Constant)}
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        # a prefix-cache ENTRY dict: the depth/ckpt shape (memo summary
+        # lines carry neither key, so the two planes can't cross-match)
+        if not {"schema", "depth", "ckpt"} <= entry_keys(node):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if not (isinstance(k, ast.Constant) and k.value == "schema"):
+                continue
+            if not (isinstance(v, ast.Name)
+                    and v.id == "PREFIXCACHE_SCHEMA_VERSION"):
+                out.append(Violation(
+                    "prefix-schema", f"{MEMOCACHE_PATH}:{v.lineno}",
+                    "prefix cache entry stamps schema with something "
+                    "other than the PREFIXCACHE_SCHEMA_VERSION Name — "
+                    "write and check sites must not be able to diverge"))
+
+    cls = next((n for n in tree.body
+                if isinstance(n, ast.ClassDef)
+                and n.name == "PrefixCache"), None)
+    if cls is None:
+        return out + [Violation(
+            "prefix-schema", MEMOCACHE_PATH,
+            "no PrefixCache class in utils/memocache.py")]
+
+    def visit(node: ast.AST, locked_ctx: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            locked_ctx = locked_ctx or any(
+                _is_locked_ctx(item.context_expr) for item in node.items)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "open":
+            mode = node.args[1] if len(node.args) > 1 else next(
+                (kw.value for kw in node.keywords if kw.arg == "mode"),
+                None)
+            writes = (isinstance(mode, ast.Constant)
+                      and isinstance(mode.value, str)
+                      and any(c in mode.value for c in "wa+x"))
+            if writes and not locked_ctx:
+                out.append(Violation(
+                    "prefix-schema", f"{MEMOCACHE_PATH}:{node.lineno}",
+                    "PrefixCache opens its store for writing outside a "
+                    "`with locked(...)` block (utils/filelock) — an "
+                    "unlocked write can tear a checkpoint another "
+                    "serve-fleet worker forks from"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked_ctx)
+
+    visit(cls, False)
     return out
 
 
@@ -1120,6 +1249,7 @@ ALL_RULES = (
     check_scatter_mode,
     check_memo_knob,
     check_memo_schema,
+    check_prefix_schema,
     check_serve_knob,
     check_serve_schema,
     check_host_sync,

@@ -330,6 +330,20 @@ def _stream_entry(memo: str = "off") -> Entry:
     # the trace small while exercising the memo="full" signature plane
     order = jnp.arange(len(jobs), dtype=jnp.int32)
     followers = jnp.zeros((len(jobs),), jnp.int32)
+    if memo == "prefix":
+        # the prefix-admission step adds the fork operands on top of the
+        # memo signature: a checkpoint BANK of lane rows plus the
+        # JOB-indexed fork source/depth maps. An all-cold plan (every
+        # fork_src -1, a single template bank row) keeps the trace small
+        # while exercising the fork-scatter arm the planner drives.
+        bank = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[:1], state)
+        fork_src = jnp.full((len(jobs),), -1, jnp.int32)
+        fork_depth = jnp.zeros((len(jobs),), jnp.int32)
+        return Entry(key="batch.stream.step.memo=prefix", fn=step,
+                     args=(state, stream, pool_dev, order, followers,
+                           None, None, None, None, bank, fork_src,
+                           fork_depth),
+                     jit_fn=step, donated=(0, 1), state_out=False)
     return Entry(key=f"batch.stream.step.memo={memo}", fn=step,
                  args=(state, stream, pool_dev, order, followers),
                  jit_fn=step, donated=(0, 1), state_out=False)
@@ -524,6 +538,7 @@ def iter_entry_builders(mode: str = "full"):
             lambda s=scheduler: _storm_entry(s))
     yield "batch.stream.step", _stream_entry
     yield "batch.stream.step.memo=full", (lambda: _stream_entry("full"))
+    yield "batch.stream.step.memo=prefix", (lambda: _stream_entry("prefix"))
     yield "batch.stream.step.serve", _serve_entry
     for comm in ("dense", "sparse"):
         yield f"graphshard.dispatch.comm={comm}", (
